@@ -1,0 +1,25 @@
+"""spark_tpu: a TPU-native analytics engine with Spark SQL's capabilities.
+
+See SURVEY.md for the blueprint (reference: apache/spark 3.3.0-SNAPSHOT)
+and README.md for the architecture stance: Catalyst-shaped compiler,
+columnar jax.Array batches, XLA as the whole-stage codegen, collectives
+as the shuffle.
+"""
+
+import jax
+
+# The engine operates on 64-bit SQL types (BIGINT, DOUBLE, scaled-int64
+# decimals); enable them globally before any array is created.
+jax.config.update("jax_enable_x64", True)
+
+from . import functions  # noqa: E402
+from . import types  # noqa: E402
+from .columnar import Batch, Column  # noqa: E402
+from .config import Conf  # noqa: E402
+from .dataframe import DataFrame  # noqa: E402
+from .session import SparkTpuSession  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = ["SparkTpuSession", "DataFrame", "Batch", "Column", "Conf",
+           "functions", "types", "__version__"]

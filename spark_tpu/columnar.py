@@ -1,0 +1,336 @@
+"""Columnar batch substrate: the device-side data representation.
+
+This replaces the reference's row/columnar tier (UnsafeRow
+`sql/catalyst/src/main/java/.../expressions/UnsafeRow.java:62`,
+`ColumnarBatch.java:30`, `OnHeap/OffHeapColumnVector.java`) with a
+TPU-native struct-of-arrays design (SURVEY.md section 2.4):
+
+- a :class:`Column` is one flat ``jax.Array`` of a fixed device dtype plus
+  an optional boolean validity array (NULL mask) and, for strings, a
+  host-side pyarrow dictionary (values live on host; codes on device);
+- a :class:`Batch` is an ordered dict of Columns sharing a *capacity*
+  (padded row count) and a *selection* mask marking live rows. Filters
+  update the selection instead of compacting, keeping shapes static for
+  XLA (the static-shape discipline of SURVEY.md section 7);
+- capacities are rounded up to buckets so XLA recompiles O(log n) times
+  across input sizes, not O(n).
+
+Batch is registered as a JAX pytree so whole batches flow through
+``jax.jit`` / ``shard_map`` directly.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from . import types as T
+
+
+def bucket_capacity(n: int, growth: float = 2.0, floor: int = 8) -> int:
+    """Round n up to the padding bucket (power-of-growth), bounding the
+    number of distinct compiled shapes."""
+    if n <= floor:
+        return floor
+    k = math.ceil(math.log(n / floor, growth))
+    return int(floor * growth ** k)
+
+
+class Column:
+    """One device column: data + optional validity + optional host dictionary."""
+
+    __slots__ = ("data", "validity", "dtype", "dictionary")
+
+    def __init__(self, data, dtype: T.DataType, validity=None,
+                 dictionary: Optional[pa.Array] = None):
+        self.data = data
+        self.dtype = dtype
+        self.validity = validity  # None means all-valid
+        self.dictionary = dictionary  # host pyarrow array for StringType
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def with_data(self, data, validity="__keep__") -> "Column":
+        v = self.validity if validity == "__keep__" else validity
+        return Column(data, self.dtype, v, self.dictionary)
+
+    def __repr__(self) -> str:
+        return (f"Column({self.dtype!r}, cap={self.capacity}, "
+                f"nullable={self.validity is not None}, "
+                f"dict={len(self.dictionary) if self.dictionary is not None else None})")
+
+
+def _col_flatten(c: Column):
+    if c.validity is None:
+        return (c.data,), (False, c.dtype, c.dictionary)
+    return (c.data, c.validity), (True, c.dtype, c.dictionary)
+
+
+def _col_unflatten(aux, children):
+    has_validity, dtype, dictionary = aux
+    if has_validity:
+        data, validity = children
+    else:
+        (data,), validity = children, None
+    return Column(data, dtype, validity, dictionary)
+
+
+jax.tree_util.register_pytree_node(Column, _col_flatten, _col_unflatten)
+
+
+class Batch:
+    """An ordered set of equal-capacity Columns plus a row-selection mask.
+
+    ``selection`` is a bool[capacity] array; None means all `capacity`
+    rows are live. ``num_rows()`` is a traced scalar (selection.sum()).
+    """
+
+    __slots__ = ("columns", "selection")
+
+    def __init__(self, columns: Dict[str, Column], selection=None):
+        self.columns = dict(columns)
+        self.selection = selection
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(data: Dict[str, np.ndarray], num_rows: Optional[int] = None,
+                   dtypes: Optional[Dict[str, T.DataType]] = None,
+                   growth: float = 2.0) -> "Batch":
+        cols = {}
+        n = num_rows
+        for name, arr in data.items():
+            if n is None:
+                n = len(arr)
+            cap = bucket_capacity(n, growth)
+            dt = (dtypes or {}).get(name) or _np_to_dtype(arr.dtype)
+            padded = np.zeros(cap, dtype=dt.np_dtype)
+            padded[:n] = arr[:n]
+            cols[name] = Column(jnp.asarray(padded), dt)
+        sel = jnp.arange(cap) < n
+        return Batch(cols, sel)
+
+    @staticmethod
+    def from_arrow(table: pa.Table, growth: float = 2.0) -> "Batch":
+        """Ingest a pyarrow table: dictionary-encode strings, pad to bucket.
+
+        Replaces the reference's vectorized Parquet column readers
+        (`VectorizedParquetRecordReader.java:54`) as the host->HBM edge."""
+        n = table.num_rows
+        cap = bucket_capacity(n, growth)
+        cols: Dict[str, Column] = {}
+        for name, col in zip(table.column_names, table.columns):
+            cols[name] = _arrow_to_column(name, col, n, cap)
+        sel = jnp.arange(cap) < n
+        return Batch(cols, sel)
+
+    # -- shape/meta ---------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        for c in self.columns.values():
+            return c.capacity
+        return 0
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def num_rows(self):
+        """Traced count of live rows."""
+        if self.selection is None:
+            return jnp.asarray(self.capacity, dtype=jnp.int32)
+        return jnp.sum(self.selection).astype(jnp.int32)
+
+    def schema(self) -> T.Schema:
+        return T.Schema([T.Field(n, c.dtype, c.validity is not None)
+                         for n, c in self.columns.items()])
+
+    def selection_mask(self):
+        if self.selection is None:
+            return jnp.ones((self.capacity,), dtype=jnp.bool_)
+        return self.selection
+
+    # -- transforms ---------------------------------------------------------
+
+    def with_selection(self, sel) -> "Batch":
+        return Batch(self.columns, sel)
+
+    def select(self, names: Sequence[str]) -> "Batch":
+        return Batch({n: self.columns[n] for n in names}, self.selection)
+
+    def with_column(self, name: str, col: Column) -> "Batch":
+        cols = dict(self.columns)
+        cols[name] = col
+        return Batch(cols, self.selection)
+
+    # -- egress -------------------------------------------------------------
+
+    def to_arrow(self) -> pa.Table:
+        """Compact (drop unselected rows), decode dictionaries, return host table."""
+        sel = np.asarray(self.selection_mask())
+        arrays = []
+        names = []
+        for name, col in self.columns.items():
+            data = np.asarray(col.data)[sel]
+            valid = None
+            if col.validity is not None:
+                valid = np.asarray(col.validity)[sel]
+            arrays.append(_column_to_arrow(col, data, valid))
+            names.append(name)
+        return pa.table(arrays, names=names)
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def __repr__(self) -> str:
+        return f"Batch(cap={self.capacity}, cols={self.columns!r})"
+
+
+def _batch_flatten(b: Batch):
+    names = tuple(b.columns.keys())
+    has_sel = b.selection is not None
+    children = tuple(b.columns[n] for n in names)
+    if has_sel:
+        children = children + (b.selection,)
+    return children, (names, has_sel)
+
+
+def _batch_unflatten(aux, children):
+    names, has_sel = aux
+    if has_sel:
+        *cols, sel = children
+    else:
+        cols, sel = children, None
+    return Batch({n: c for n, c in zip(names, cols)}, sel)
+
+
+jax.tree_util.register_pytree_node(Batch, _batch_flatten, _batch_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# Arrow conversion helpers
+# ---------------------------------------------------------------------------
+
+_ARROW_TO_DTYPE = {
+    pa.bool_(): T.BOOLEAN,
+    pa.int8(): T.BYTE,
+    pa.int16(): T.SHORT,
+    pa.int32(): T.INT,
+    pa.int64(): T.LONG,
+    pa.float32(): T.FLOAT,
+    pa.float64(): T.DOUBLE,
+    pa.date32(): T.DATE,
+    pa.timestamp("us"): T.TIMESTAMP,
+}
+
+
+def _np_to_dtype(np_dtype) -> T.DataType:
+    m = {np.dtype(np.bool_): T.BOOLEAN, np.dtype(np.int8): T.BYTE,
+         np.dtype(np.int16): T.SHORT, np.dtype(np.int32): T.INT,
+         np.dtype(np.int64): T.LONG, np.dtype(np.float32): T.FLOAT,
+         np.dtype(np.float64): T.DOUBLE}
+    if np_dtype not in m:
+        raise TypeError(f"unsupported numpy dtype {np_dtype}")
+    return m[np_dtype]
+
+
+def _arrow_to_column(name: str, col: pa.ChunkedArray, n: int, cap: int) -> Column:
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    at = arr.type
+    dictionary = None
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        arr = arr.dictionary_encode()
+        at = arr.type
+    if pa.types.is_dictionary(at):
+        dictionary = arr.dictionary
+        codes = arr.indices.cast(pa.int32())
+        np_data = codes.to_numpy(zero_copy_only=False)
+        dt: T.DataType = T.STRING
+    elif pa.types.is_decimal(at):
+        dt = T.DecimalType(at.precision, at.scale)
+        # exact unscaled int64: read the low 64-bit limb of the 128-bit
+        # little-endian decimal buffer (two's complement reinterpret is
+        # exact for values in int64 range, which our repr requires)
+        arr128 = arr.cast(pa.decimal128(38, at.scale))
+        buf = arr128.buffers()[1]
+        raw = np.frombuffer(buf, dtype=np.uint64,
+                            count=2 * (arr128.offset + len(arr128)))
+        raw = raw.reshape(-1, 2)[arr128.offset:, :]
+        lo = raw[:, 0].astype(np.int64)  # two's complement low limb
+        hi = raw[:, 1].view(np.int64)
+        expect_hi = lo >> 63  # sign extension when value fits in int64
+        if not np.array_equal(hi[~np.asarray(arr128.is_null()).astype(bool)]
+                              if arr128.null_count else hi,
+                              expect_hi[~np.asarray(arr128.is_null()).astype(bool)]
+                              if arr128.null_count else expect_hi):
+            raise OverflowError(
+                f"decimal column {name} exceeds int64 unscaled range")
+        np_data = lo
+    elif at == pa.date32():
+        dt = T.DATE
+        np_data = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+    elif pa.types.is_timestamp(at):
+        dt = T.TIMESTAMP
+        np_data = arr.cast(pa.timestamp("us")).cast(pa.int64()).to_numpy(
+            zero_copy_only=False)
+    else:
+        dt = _ARROW_TO_DTYPE.get(at)
+        if dt is None:
+            raise TypeError(f"unsupported arrow type {at} for column {name}")
+        np_data = arr.cast(pa.from_numpy_dtype(dt.np_dtype)).to_numpy(
+            zero_copy_only=False)
+
+    validity = None
+    if arr.null_count > 0:
+        valid_np = np.zeros(cap, dtype=np.bool_)
+        valid_np[:n] = ~np.asarray(arr.is_null())
+        np_data = np.where(valid_np[:n], np_data, np.zeros((), dtype=dt.np_dtype))
+        validity = jnp.asarray(valid_np)
+
+    padded = np.zeros(cap, dtype=dt.np_dtype)
+    padded[:n] = np_data
+    return Column(jnp.asarray(padded), dt, validity, dictionary)
+
+
+def _column_to_arrow(col: Column, data: np.ndarray,
+                     valid: Optional[np.ndarray]) -> pa.Array:
+    dt = col.dtype
+    mask = None if valid is None else ~valid
+    if isinstance(dt, T.StringType):
+        if col.dictionary is None:
+            return pa.array(data.astype("U"), mask=mask)
+        codes = np.clip(data, 0, len(col.dictionary) - 1)
+        out = pa.DictionaryArray.from_arrays(
+            pa.array(codes.astype(np.int32), mask=mask), col.dictionary)
+        return out.cast(pa.string())
+    if isinstance(dt, T.DecimalType):
+        # inverse of ingest: place unscaled int64 into the low limb of a
+        # little-endian 128-bit buffer with sign extension in the high limb
+        lo = data.astype(np.int64)
+        hi = lo >> 63
+        raw = np.empty((len(lo), 2), dtype=np.int64)
+        raw[:, 0] = lo
+        raw[:, 1] = hi
+        validity_buf = None
+        if valid is not None:
+            validity_buf = pa.array(valid.astype(np.bool_)).buffers()[1]
+        return pa.Array.from_buffers(
+            pa.decimal128(max(dt.precision, 19), dt.scale), len(lo),
+            [validity_buf, pa.py_buffer(raw.tobytes())],
+            null_count=int((~valid).sum()) if valid is not None else 0)
+    if isinstance(dt, T.DateType):
+        return pa.array(data.astype(np.int32), mask=mask).cast(pa.date32())
+    if isinstance(dt, T.TimestampType):
+        return pa.array(data.astype(np.int64), mask=mask).cast(pa.timestamp("us"))
+    return pa.array(data, mask=mask)

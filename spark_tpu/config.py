@@ -1,0 +1,140 @@
+"""Declarative configuration registry.
+
+Design follows the reference's two-tier config system (Spark
+`core/src/main/scala/org/apache/spark/SparkConf.scala:54` string map +
+typed `internal/config/ConfigEntry.scala:74` declarations, and the
+session-scoped `sql/catalyst/.../internal/SQLConf.scala:56`): a single
+module-level registry of typed entries with defaults/docs/validators,
+overlaid by a per-session mutable map that is runtime-settable.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    """A typed config declaration (reference: ConfigEntry.scala:74)."""
+
+    key: str
+    default: Any
+    type_: type
+    doc: str = ""
+    validator: Optional[Callable[[Any], bool]] = None
+    version: str = "0.1.0"
+
+    def coerce(self, value: Any) -> Any:
+        if self.type_ is bool and isinstance(value, str):
+            return value.strip().lower() in ("true", "1", "yes")
+        return self.type_(value)
+
+
+_REGISTRY: Dict[str, ConfigEntry] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def register(key: str, default: Any, doc: str = "",
+             validator: Optional[Callable[[Any], bool]] = None,
+             type_: Optional[type] = None) -> ConfigEntry:
+    entry = ConfigEntry(key=key, default=default,
+                        type_=type_ or type(default), doc=doc,
+                        validator=validator)
+    with _REGISTRY_LOCK:
+        if key in _REGISTRY:
+            raise ValueError(f"duplicate config entry: {key}")
+        _REGISTRY[key] = entry
+    return entry
+
+
+def registry() -> Dict[str, ConfigEntry]:
+    return dict(_REGISTRY)
+
+
+class Conf:
+    """Session-scoped overlay over the registry (reference: SQLConf.scala:56).
+
+    Unknown keys are allowed (string passthrough) to mirror SparkConf's
+    open string map; known keys are validated and coerced.
+    """
+
+    def __init__(self, parent: Optional["Conf"] = None):
+        self._settings: Dict[str, Any] = {}
+        self._parent = parent
+
+    def set(self, key: str, value: Any) -> "Conf":
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            value = entry.coerce(value)
+            if entry.validator is not None and not entry.validator(value):
+                raise ValueError(f"invalid value for {key}: {value!r}")
+        self._settings[key] = value
+        return self
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._settings:
+            return self._settings[key]
+        if self._parent is not None and self._parent.contains(key):
+            return self._parent.get(key)
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            return entry.default
+        return default
+
+    def contains(self, key: str) -> bool:
+        return (key in self._settings
+                or (self._parent is not None and self._parent.contains(key))
+                or key in _REGISTRY)
+
+    def unset(self, key: str) -> None:
+        self._settings.pop(key, None)
+
+    def copy(self) -> "Conf":
+        c = Conf(parent=self._parent)
+        c._settings.update(self._settings)
+        return c
+
+
+# ---------------------------------------------------------------------------
+# Core entries (analog of internal/config/package.scala + SQLConf registrations)
+# ---------------------------------------------------------------------------
+
+AGG_SORT_FALLBACK = register(
+    "spark_tpu.sql.aggregate.maxDirectDomain", 1 << 22,
+    doc="Max combined integer key domain for the direct scatter-add "
+        "aggregate fast path; larger domains use the sort-based aggregate.")
+
+AGG_TABLE_SIZE = register(
+    "spark_tpu.sql.aggregate.estimatedGroups", 1 << 16,
+    doc="Estimated distinct group count used to size hash-aggregate output "
+        "when no tighter bound can be inferred (AQE may revise).")
+
+SHUFFLE_PARTITIONS = register(
+    "spark_tpu.sql.shuffle.partitions", 8,
+    doc="Number of logical shuffle partitions (mesh data axis size).")
+
+BROADCAST_THRESHOLD = register(
+    "spark_tpu.sql.autoBroadcastJoinThreshold", 64 << 20,
+    doc="Max estimated build-side bytes for broadcast (all_gather) joins; "
+        "analog of spark.sql.autoBroadcastJoinThreshold.")
+
+BATCH_BUCKET_GROWTH = register(
+    "spark_tpu.sql.execution.bucketGrowth", 2.0,
+    doc="Padding bucket growth factor: batch capacities are rounded up to "
+        "powers of this factor to bound XLA recompilation across batch "
+        "sizes (static-shape discipline, SURVEY.md section 7).")
+
+ADAPTIVE_ENABLED = register(
+    "spark_tpu.sql.adaptive.enabled", True,
+    doc="Enable adaptive re-planning between stages from runtime row "
+        "counts (analog of spark.sql.adaptive.enabled).")
+
+CASE_SENSITIVE = register(
+    "spark_tpu.sql.caseSensitive", False,
+    doc="Whether column resolution is case sensitive.")
+
+ANSI_ENABLED = register(
+    "spark_tpu.sql.ansi.enabled", False,
+    doc="ANSI mode: overflow/ invalid-cast errors instead of nulls.")

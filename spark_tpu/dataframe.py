@@ -1,0 +1,163 @@
+"""DataFrame API: a lazy logical-plan holder.
+
+The analog of the reference's `Dataset.scala:191` — every method builds a
+new logical plan; actions (`collect`, `count`, `to_pandas`) run the
+QueryExecution pipeline. Naming follows pyspark (`python/pyspark/sql/
+dataframe.py`) so a Spark user can switch with minimal friction.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from . import types as T
+from .expr import (Alias, AnalysisError, ColumnRef, EQ, Expression, SortOrder)
+from .expr_agg import AggExpr, AggregateFunction, Count
+from .plan import logical as L
+
+
+def _expr(e) -> Expression:
+    from .functions import _expr as f
+    return f(e)
+
+
+class DataFrame:
+    def __init__(self, session, plan: L.LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # -- transformations ----------------------------------------------------
+
+    def _with(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(self.session, plan)
+
+    def select(self, *exprs) -> "DataFrame":
+        es = [_expr(e) for e in exprs]
+        return self._with(L.Project(self.plan, es))
+
+    def filter(self, condition: Expression) -> "DataFrame":
+        return self._with(L.Filter(self.plan, condition))
+
+    where = filter
+
+    def with_column(self, name: str, e: Expression) -> "DataFrame":
+        exprs: List[Expression] = []
+        replaced = False
+        for n in self.plan.schema().names:
+            if n == name:
+                exprs.append(Alias(_expr(e), name))
+                replaced = True
+            else:
+                exprs.append(ColumnRef(n))
+        if not replaced:
+            exprs.append(Alias(_expr(e), name))
+        return self._with(L.Project(self.plan, exprs))
+
+    withColumn = with_column
+
+    def group_by(self, *group_exprs) -> "GroupedData":
+        return GroupedData(self, [_expr(g) for g in group_exprs])
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             left_on=None, right_on=None,
+             condition: Optional[Expression] = None) -> "DataFrame":
+        if how == "right":
+            raise AnalysisError("right join: call other.join(self, how='left')")
+        if on is not None:
+            names = [on] if isinstance(on, str) else list(on)
+            lk = [ColumnRef(n) for n in names]
+            rk = [ColumnRef(n) for n in names]
+        else:
+            lk = [_expr(e) for e in (left_on if isinstance(left_on, (list, tuple))
+                                     else [left_on])]
+            rk = [_expr(e) for e in (right_on if isinstance(right_on, (list, tuple))
+                                     else [right_on])]
+        return self._with(L.Join(self.plan, other.plan, lk, rk, how, condition))
+
+    def sort(self, *orders) -> "DataFrame":
+        os = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                os.append(o)
+            else:
+                os.append(SortOrder(_expr(o), ascending=True))
+        return self._with(L.Sort(self.plan, os))
+
+    orderBy = sort
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._with(L.Limit(self.plan, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.Union(self.plan, other.plan))
+
+    unionAll = union
+
+    # -- metadata -----------------------------------------------------------
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.plan.schema()
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.schema().names
+
+    def explain(self, extended: bool = False) -> None:
+        print(self._qe().explain(extended))
+
+    # -- actions ------------------------------------------------------------
+
+    def _qe(self):
+        from .execution.executor import QueryExecution
+        return QueryExecution(self.session, self.plan)
+
+    def collect(self) -> pa.Table:
+        return self._qe().collect()
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    toPandas = to_pandas
+
+    def count(self) -> int:
+        from .expr_agg import AggExpr, Count
+        agg = L.Aggregate(self.plan, [], [AggExpr(Count(None), "count")])
+        table = DataFrame(self.session, agg).collect()
+        return table.column("count")[0].as_py()
+
+    def show(self, n: int = 20) -> None:
+        print(self.limit(n).to_pandas().to_string())
+
+
+class GroupedData:
+    """Reference: RelationalGroupedDataset."""
+
+    def __init__(self, df: DataFrame, group_exprs: List[Expression]):
+        self._df = df
+        self._groups = group_exprs
+
+    def agg(self, *aggs) -> DataFrame:
+        agg_exprs = []
+        for a in aggs:
+            if isinstance(a, AggExpr):
+                agg_exprs.append(a)
+            elif isinstance(a, Alias) and isinstance(a.child, AggregateFunction):
+                agg_exprs.append(AggExpr(a.child, a.name()))
+            elif isinstance(a, AggregateFunction):
+                agg_exprs.append(AggExpr(a, repr(a)))
+            else:
+                raise AnalysisError(f"not an aggregate: {a!r}")
+        plan = L.Aggregate(self._df.plan, self._groups, agg_exprs)
+        return DataFrame(self._df.session, plan)
+
+    def count(self) -> DataFrame:
+        return self.agg(AggExpr(Count(None), "count"))

@@ -1,0 +1,82 @@
+"""Equi-join kernel: sorted-build + binary-search probe.
+
+Replaces the reference's hash join tier (`HashedRelation.scala:41`,
+`BroadcastHashJoinExec.scala:40`, `ShuffledHashJoinExec.scala:37`) with a
+sort+searchsorted formulation that XLA maps well onto TPU: the build side
+is sorted once (`lax.sort`), each probe key binary-searches
+(`jnp.searchsorted`), and matched build rows are gathered. O((m+n) log n)
+with fully static shapes.
+
+This kernel requires *unique* build-side keys (the FK-join case: every
+TPC-H join probes a primary key). Duplicate build keys are detected on
+device and surfaced as a `dup_detected` flag the executor checks —
+many-to-many joins are planned to expand via a different strategy
+(SURVEY.md section 7, "hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar import Batch, Column
+from ..expr import Vec
+
+
+def build_sorted(key: Vec, sel) -> Tuple:
+    """Sort build side by key; invalid rows pushed to the end.
+
+    Returns (sorted_keys, perm, num_valid, dup_detected)."""
+    cap = key.data.shape[0]
+    invalid = jnp.zeros((cap,), jnp.int8)
+    if sel is not None:
+        invalid = (~sel).astype(jnp.int8)
+    if key.validity is not None:
+        invalid = invalid | (~key.validity).astype(jnp.int8)
+    perm0 = jnp.arange(cap, dtype=jnp.int32)
+    inv_s, keys_s, perm = jax.lax.sort((invalid, key.data, perm0), num_keys=2)
+    valid_s = inv_s == 0
+    n_valid = jnp.sum(valid_s.astype(jnp.int32))
+    # invalid slots carry arbitrary keys after the valid prefix; overwrite
+    # with +max so the array is globally sorted for binary search
+    if jnp.issubdtype(keys_s.dtype, jnp.floating):
+        sentinel = jnp.asarray(np.inf, keys_s.dtype)
+    else:
+        sentinel = jnp.asarray(np.iinfo(np.dtype(keys_s.dtype)).max, keys_s.dtype)
+    keys_s = jnp.where(valid_s, keys_s, sentinel)
+    adj_dup = (keys_s[1:] == keys_s[:-1]) & valid_s[1:] & valid_s[:-1]
+    dup = jnp.any(adj_dup)
+    return keys_s, perm, n_valid, valid_s, dup
+
+
+def probe(sorted_keys, perm, n_valid, probe_key: Vec, probe_sel):
+    """Binary-search probe. Returns (match_idx into build batch, found mask)."""
+    pos = jnp.searchsorted(sorted_keys, probe_key.data)
+    pos_c = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
+    hit_key = jnp.take(sorted_keys, pos_c)
+    found = (pos < n_valid) & (hit_key == probe_key.data)
+    if probe_key.validity is not None:
+        found = found & probe_key.validity
+    if probe_sel is not None:
+        found = found & probe_sel
+    match_idx = jnp.take(perm, pos_c)
+    return match_idx, found
+
+
+def gather_build_columns(build: Batch, match_idx, found,
+                         name_map: List[Tuple[str, str]]) -> List[Tuple[str, Column]]:
+    """Gather build-side columns at match_idx; validity &= found."""
+    out = []
+    for src_name, out_name in name_map:
+        col = build.columns[src_name]
+        data = jnp.take(col.data, match_idx)
+        if col.validity is not None:
+            validity = jnp.take(col.validity, match_idx) & found
+        else:
+            validity = found
+        out.append((out_name, Column(data, col.dtype, validity, col.dictionary)))
+    return out

@@ -1,0 +1,86 @@
+"""Sort kernel: multi-key `lax.sort` with permutation payload.
+
+Replaces the reference's Tungsten sort tier (`SortExec.scala:40`,
+`UnsafeExternalSorter.java`, `RadixSort.java`): XLA's `lax.sort` is the
+device sort; there is no spill tier because batches are HBM-resident and
+statically shaped. Orders follow Spark semantics: ASC -> NULLS FIRST,
+DESC -> NULLS LAST by default; DESC on strings sorts by host-computed
+dictionary rank (a static lookup table), since codes are not ordered.
+Unselected rows sort to the end, so a sort also compacts the selection.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..columnar import Batch, Column
+from ..expr import SortOrder, Vec
+
+
+def _rank_table(dictionary: pa.Array):
+    """code -> lexicographic rank, computed once on host (static)."""
+    order = pc.array_sort_indices(dictionary)
+    ranks = np.empty(len(dictionary), dtype=np.int32)
+    ranks[order.to_numpy(zero_copy_only=False)] = np.arange(
+        len(dictionary), dtype=np.int32)
+    return jnp.asarray(ranks)
+
+
+def sort_key_operand(vec: Vec, ascending: bool):
+    """Map a key column to an ascending-sortable operand of its dtype."""
+    data = vec.data
+    if isinstance(vec.dtype, T.StringType):
+        if vec.dictionary is None:
+            raise ValueError("sort on string requires dictionary")
+        table = _rank_table(vec.dictionary)
+        data = jnp.take(table, jnp.clip(data, 0, len(table) - 1))
+    if isinstance(vec.dtype, T.BooleanType):
+        data = data.astype(jnp.int8)
+    if not ascending:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            data = -data
+        else:
+            data = ~data  # bitwise complement reverses integer order, no overflow
+    return data
+
+
+def sort_permutation(batch: Batch, orders: Sequence[SortOrder]):
+    """Returns (perm, num_valid): perm puts rows in order with unselected
+    rows last; gathering all columns by perm and selecting iota<num_valid
+    yields the sorted, compacted batch."""
+    cap = batch.capacity
+    sel = batch.selection
+    operands = []
+    invalid = jnp.zeros((cap,), jnp.int8) if sel is None else (~sel).astype(jnp.int8)
+    operands.append(invalid)
+    for o in orders:
+        vec = o.eval(batch)
+        if vec.validity is not None:
+            nulls = (~vec.validity).astype(jnp.int8)
+            # ASC+NULLS FIRST: null rank 0; NULLS LAST: null rank 1
+            rank = nulls if not o.nulls_first else (1 - nulls)
+            operands.append(rank.astype(jnp.int8))
+        operands.append(sort_key_operand(vec, o.ascending))
+    num_keys = len(operands)
+    operands.append(jnp.arange(cap, dtype=jnp.int32))
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
+    perm = sorted_ops[-1]
+    n_valid = jnp.sum((sorted_ops[0] == 0).astype(jnp.int32))
+    return perm, n_valid
+
+
+def apply_permutation(batch: Batch, perm, n_valid) -> Batch:
+    cols = {}
+    for name, col in batch.columns.items():
+        data = jnp.take(col.data, perm)
+        validity = None if col.validity is None else jnp.take(col.validity, perm)
+        cols[name] = Column(data, col.dtype, validity, col.dictionary)
+    sel = jnp.arange(batch.capacity) < n_valid
+    return Batch(cols, sel)

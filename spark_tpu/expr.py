@@ -1,0 +1,877 @@
+"""Expression IR: typed tree lowering to whole-column jnp programs.
+
+The reference implements ~400 expressions with dual interpreted/codegen
+paths (`sql/catalyst/.../expressions/Expression.scala:86` — `eval:129` and
+`doGenCode:202`). Here there is a single path: ``eval`` builds a traced
+jnp computation over whole columns; "codegen" is ``jax.jit`` of the
+composed program — XLA fusion replaces Janino whole-stage codegen
+(`CodeGenerator.scala:1435`, `WholeStageCodegenExec.scala:626`).
+
+Null semantics follow the reference: NULL-propagating arithmetic,
+Kleene three-valued AND/OR, null-safe IsNull/IsNotNull. NULLs ride a
+boolean validity array (None == all valid), mirroring validity bitmaps of
+`ColumnVector.java` rather than UnsafeRow null bits.
+
+String expressions are dictionary-aware: comparisons/LIKE against
+literals are evaluated once on the host-side dictionary and become O(1)
+code lookups on device (SURVEY.md section 7 "Strings/varlen on TPU").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from . import types as T
+from .columnar import Batch, Column
+
+
+@dataclass
+class Vec:
+    """An evaluated column-expression: data + validity + type + dictionary."""
+
+    data: Any
+    dtype: T.DataType
+    validity: Any = None  # None = all valid
+    dictionary: Optional[pa.Array] = None
+
+    def valid_mask(self):
+        if self.validity is None:
+            return None
+        return self.validity
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class AnalysisError(Exception):
+    pass
+
+
+class Expression:
+    """Base expression node."""
+
+    children: Tuple["Expression", ...] = ()
+
+    def dtype(self, schema: T.Schema) -> T.DataType:
+        raise NotImplementedError
+
+    def nullable(self, schema: T.Schema) -> bool:
+        return any(c.nullable(schema) for c in self.children) if self.children else True
+
+    def eval(self, batch: Batch) -> Vec:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return repr(self)
+
+    # -- tree utilities (reference: TreeNode.scala transform combinators) ---
+
+    def map_children(self, f: Callable[["Expression"], "Expression"]) -> "Expression":
+        if not self.children:
+            return self
+        import copy
+        new = copy.copy(self)
+        new.children = tuple(f(c) for c in self.children)
+        return new
+
+    def transform_up(self, f) -> "Expression":
+        node = self.map_children(lambda c: c.transform_up(f))
+        return f(node)
+
+    def references(self) -> set:
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    def foldable(self) -> bool:
+        return bool(self.children) and all(c.foldable() for c in self.children)
+
+    # sugar so users can compose: (col("a") + 1 > col("b")) & ...
+    def __add__(self, o): return Add(self, _wrap(o))
+    def __radd__(self, o): return Add(_wrap(o), self)
+    def __sub__(self, o): return Sub(self, _wrap(o))
+    def __rsub__(self, o): return Sub(_wrap(o), self)
+    def __mul__(self, o): return Mul(self, _wrap(o))
+    def __rmul__(self, o): return Mul(_wrap(o), self)
+    def __truediv__(self, o): return Div(self, _wrap(o))
+    def __rtruediv__(self, o): return Div(_wrap(o), self)
+    def __mod__(self, o): return Mod(self, _wrap(o))
+    def __neg__(self): return Neg(self)
+    def __eq__(self, o): return EQ(self, _wrap(o))  # type: ignore[override]
+    def __ne__(self, o): return NE(self, _wrap(o))  # type: ignore[override]
+    def __lt__(self, o): return LT(self, _wrap(o))
+    def __le__(self, o): return LE(self, _wrap(o))
+    def __gt__(self, o): return GT(self, _wrap(o))
+    def __ge__(self, o): return GE(self, _wrap(o))
+    def __and__(self, o): return And(self, _wrap(o))
+    def __rand__(self, o): return And(_wrap(o), self)
+    def __or__(self, o): return Or(self, _wrap(o))
+    def __ror__(self, o): return Or(_wrap(o), self)
+    def __invert__(self): return Not(self)
+    def __hash__(self):
+        return hash((type(self).__name__, self.children))
+
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, dt: T.DataType) -> "Cast":
+        return Cast(self, dt)
+
+    def is_null(self) -> "IsNull":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Not":
+        return Not(IsNull(self))
+
+    def isin(self, *values) -> "In":
+        return In(self, tuple(values))
+
+    def between(self, lo, hi) -> "Expression":
+        return And(GE(self, _wrap(lo)), LE(self, _wrap(hi)))
+
+    def like(self, pattern: str) -> "Like":
+        return Like(self, pattern)
+
+    def startswith(self, prefix: str) -> "Like":
+        return Like(self, prefix.replace("%", r"\%").replace("_", r"\_") + "%")
+
+    def substr(self, start: int, length: int) -> "Substring":
+        return Substring(self, start, length)
+
+    def asc(self) -> "SortOrder":
+        return SortOrder(self, ascending=True)
+
+    def desc(self) -> "SortOrder":
+        return SortOrder(self, ascending=False)
+
+
+def _wrap(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+def structurally_equal(a: Expression, b: Expression) -> bool:
+    """Semantic (structural) equality — `__eq__` is overloaded for DSL use."""
+    if type(a) is not type(b):
+        return False
+    sa = {k: v for k, v in a.__dict__.items() if k != "children"}
+    sb = {k: v for k, v in b.__dict__.items() if k != "children"}
+    if sa.keys() != sb.keys():
+        return False
+    for k in sa:
+        va, vb = sa[k], sb[k]
+        if isinstance(va, Expression) or isinstance(vb, Expression):
+            if not (isinstance(va, Expression) and isinstance(vb, Expression)
+                    and structurally_equal(va, vb)):
+                return False
+        elif va is not vb and va != vb:
+            return False
+    if len(a.children) != len(b.children):
+        return False
+    return all(structurally_equal(x, y) for x, y in zip(a.children, b.children))
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class ColumnRef(Expression):
+    """Unresolved-by-name column reference (reference: UnresolvedAttribute)."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self.children = ()
+
+    def dtype(self, schema: T.Schema) -> T.DataType:
+        return _resolve_field(schema, self._name).dtype
+
+    def nullable(self, schema: T.Schema) -> bool:
+        return _resolve_field(schema, self._name).nullable
+
+    def eval(self, batch: Batch) -> Vec:
+        col = _resolve_column(batch, self._name)
+        return Vec(col.data, col.dtype, col.validity, col.dictionary)
+
+    def references(self) -> set:
+        return {self._name}
+
+    def foldable(self) -> bool:
+        return False
+
+    def name(self) -> str:
+        return self._name
+
+    def __repr__(self) -> str:
+        return self._name
+
+
+def _resolve_field(schema: T.Schema, name: str) -> T.Field:
+    matches = [f for f in schema.fields if f.name == name]
+    if not matches:
+        matches = [f for f in schema.fields if f.name.lower() == name.lower()]
+    if not matches:
+        raise AnalysisError(
+            f"column {name!r} not found among {schema.names}")
+    if len(matches) > 1:
+        raise AnalysisError(f"ambiguous column {name!r}")
+    return matches[0]
+
+
+def _resolve_column(batch: Batch, name: str) -> Column:
+    if name in batch.columns:
+        return batch.columns[name]
+    for n, c in batch.columns.items():
+        if n.lower() == name.lower():
+            return c
+    raise AnalysisError(f"column {name!r} not found among {batch.names}")
+
+
+class Literal(Expression):
+    def __init__(self, value, dtype: Optional[T.DataType] = None):
+        self.value = value
+        self._dtype = dtype or _infer_literal_type(value)
+        self.children = ()
+
+    def dtype(self, schema=None) -> T.DataType:
+        return self._dtype
+
+    def nullable(self, schema=None) -> bool:
+        return self.value is None
+
+    def foldable(self) -> bool:
+        return True
+
+    def eval(self, batch: Batch) -> Vec:
+        return self.eval_scalar()
+
+    def eval_scalar(self) -> Vec:
+        if self.value is None:
+            return Vec(jnp.zeros((), dtype=self._dtype.np_dtype), self._dtype,
+                       validity=jnp.zeros((), dtype=jnp.bool_))
+        v = self.value
+        if isinstance(self._dtype, T.DecimalType):
+            v = int(round(float(v) * 10 ** self._dtype.scale))
+        if isinstance(self._dtype, T.StringType):
+            # scalar strings stay host-side; comparisons special-case them
+            return Vec(None, self._dtype, None, None)
+        return Vec(jnp.asarray(v, dtype=self._dtype.np_dtype), self._dtype)
+
+    def __repr__(self) -> str:
+        return repr(self.value)
+
+
+def _infer_literal_type(v) -> T.DataType:
+    import datetime
+    import decimal
+    if v is None:
+        return T.NULL
+    if isinstance(v, bool):
+        return T.BOOLEAN
+    if isinstance(v, int):
+        return T.LONG if not (-2**31 <= v < 2**31) else T.INT
+    if isinstance(v, float):
+        return T.DOUBLE
+    if isinstance(v, str):
+        return T.STRING
+    if isinstance(v, decimal.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = max(0, -exp)
+        return T.DecimalType(max(len(digits), scale + 1), scale)
+    if isinstance(v, datetime.date) and not isinstance(v, datetime.datetime):
+        return T.DATE
+    if isinstance(v, datetime.datetime):
+        return T.TIMESTAMP
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+def date_literal(s: str) -> Literal:
+    """'1998-09-02' -> days-since-epoch DATE literal."""
+    days = (np.datetime64(s, "D") - np.datetime64("1970-01-01", "D")).astype(int)
+    lit = Literal(int(days), T.DATE)
+    return lit
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, alias_name: str):
+        self.children = (child,)
+        self._alias = alias_name
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def dtype(self, schema):
+        return self.child.dtype(schema)
+
+    def nullable(self, schema):
+        return self.child.nullable(schema)
+
+    def eval(self, batch):
+        return self.child.eval(batch)
+
+    def name(self) -> str:
+        return self._alias
+
+    def __repr__(self) -> str:
+        return f"{self.children[0]!r} AS {self._alias}"
+
+
+class SortOrder(Expression):
+    """Sort key + direction + null ordering (reference: SortOrder.scala)."""
+
+    def __init__(self, child: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.children = (child,)
+        self.ascending = ascending
+        # Spark default: NULLS FIRST for ASC, NULLS LAST for DESC
+        self.nulls_first = ascending if nulls_first is None else nulls_first
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def dtype(self, schema):
+        return self.child.dtype(schema)
+
+    def eval(self, batch):
+        return self.child.eval(batch)
+
+    def __repr__(self):
+        return f"{self.children[0]!r} {'ASC' if self.ascending else 'DESC'}"
+
+
+# ---------------------------------------------------------------------------
+# Casts and numeric helpers
+# ---------------------------------------------------------------------------
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: T.DataType):
+        self.children = (child,)
+        self.to = to
+
+    def dtype(self, schema):
+        return self.to
+
+    def eval(self, batch: Batch) -> Vec:
+        v = self.children[0].eval(batch)
+        return cast_vec(v, self.to)
+
+    def __repr__(self):
+        return f"cast({self.children[0]!r} as {self.to!r})"
+
+
+def cast_vec(v: Vec, to: T.DataType) -> Vec:
+    if v.dtype == to:
+        return v
+    src = v.dtype
+    data = v.data
+    if isinstance(src, T.DecimalType) and isinstance(to, T.DecimalType):
+        ds = to.scale - src.scale
+        if ds >= 0:
+            data = data * (10 ** ds)
+        else:
+            data = _div_round_half_up(data, 10 ** (-ds))
+        return Vec(data, to, v.validity)
+    if isinstance(src, T.DecimalType):
+        if isinstance(to, (T.DoubleType, T.FloatType)):
+            return Vec((data / (10.0 ** src.scale)).astype(to.np_dtype), to, v.validity)
+        if isinstance(to, T.IntegralType):
+            return Vec(_div_round_half_up(data, 10 ** src.scale).astype(to.np_dtype),
+                       to, v.validity)
+    if isinstance(to, T.DecimalType):
+        if isinstance(src, T.IntegralType) or isinstance(src, T.BooleanType):
+            return Vec(data.astype(np.int64) * (10 ** to.scale), to, v.validity)
+        if isinstance(src, (T.DoubleType, T.FloatType)):
+            scaled = jnp.round(data.astype(np.float64) * (10.0 ** to.scale))
+            return Vec(scaled.astype(np.int64), to, v.validity)
+    if isinstance(src, T.StringType) or isinstance(to, T.StringType):
+        raise AnalysisError(f"cast {src!r} -> {to!r} not supported on device")
+    return Vec(data.astype(to.np_dtype), to, v.validity)
+
+
+def _div_round_half_up(data, divisor: int):
+    # HALF_UP rounding on integers, matching the reference Decimal.scala
+    half = divisor // 2
+    adj = jnp.where(data >= 0, data + half, data - half)
+    return adj // divisor
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic
+# ---------------------------------------------------------------------------
+
+class BinaryArithmetic(Expression):
+    op: str = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    def dtype(self, schema):
+        lt = self.children[0].dtype(schema)
+        rt = self.children[1].dtype(schema)
+        return self._result_type(lt, rt)
+
+    def _result_type(self, lt, rt):
+        return T.common_type(lt, rt)
+
+    def eval(self, batch: Batch) -> Vec:
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        validity = _and_valid(lv.validity, rv.validity)
+        out_dtype = self._result_type(lv.dtype, rv.dtype)
+        data = self._compute(lv, rv, out_dtype)
+        return Vec(data, out_dtype, validity)
+
+    def _compute(self, lv: Vec, rv: Vec, out: T.DataType):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.op} {self.children[1]!r})"
+
+
+def _align(v: Vec, out: T.DataType):
+    return cast_vec(v, out).data
+
+
+class Add(BinaryArithmetic):
+    op = "+"
+
+    def _compute(self, lv, rv, out):
+        return _align(lv, out) + _align(rv, out)
+
+
+class Sub(BinaryArithmetic):
+    op = "-"
+
+    def _compute(self, lv, rv, out):
+        return _align(lv, out) - _align(rv, out)
+
+
+class Mul(BinaryArithmetic):
+    op = "*"
+
+    def _result_type(self, lt, rt):
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            ls = lt.scale if isinstance(lt, T.DecimalType) else 0
+            rs = rt.scale if isinstance(rt, T.DecimalType) else 0
+            lp = lt.precision if isinstance(lt, T.DecimalType) else 20
+            rp = rt.precision if isinstance(rt, T.DecimalType) else 20
+            if isinstance(lt, T.NumericType) and isinstance(rt, T.NumericType) \
+                    and not isinstance(lt, (T.FloatType, T.DoubleType)) \
+                    and not isinstance(rt, (T.FloatType, T.DoubleType)):
+                return T.DecimalType(min(38, lp + rp), ls + rs)
+            return T.DOUBLE
+        return T.common_type(lt, rt)
+
+    def _compute(self, lv, rv, out):
+        if isinstance(out, T.DecimalType):
+            l = lv.data if isinstance(lv.dtype, T.DecimalType) else \
+                cast_vec(lv, T.DecimalType(20, 0)).data
+            r = rv.data if isinstance(rv.dtype, T.DecimalType) else \
+                cast_vec(rv, T.DecimalType(20, 0)).data
+            return l * r
+        return _align(lv, out) * _align(rv, out)
+
+
+class Div(BinaryArithmetic):
+    op = "/"
+
+    def _result_type(self, lt, rt):
+        # reference: integer `/` is true division returning double (Spark SQL)
+        return T.DOUBLE
+
+    def _compute(self, lv, rv, out):
+        l = cast_vec(lv, T.DOUBLE).data
+        r = cast_vec(rv, T.DOUBLE).data
+        return l / r
+
+
+class Mod(BinaryArithmetic):
+    op = "%"
+
+    def _compute(self, lv, rv, out):
+        return _align(lv, out) % _align(rv, out)
+
+
+class Neg(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def dtype(self, schema):
+        return self.children[0].dtype(schema)
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        return Vec(-v.data, v.dtype, v.validity)
+
+    def __repr__(self):
+        return f"(-{self.children[0]!r})"
+
+
+class ExtractYear(Expression):
+    """year(date) — days-since-epoch -> calendar year, branch-free."""
+
+    def __init__(self, child):
+        self.children = (child,)
+
+    def dtype(self, schema):
+        return T.INT
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        days = v.data.astype(jnp.int64)
+        # civil-from-days (Howard Hinnant's algorithm), vectorized
+        z = days + 719468
+        era = jnp.where(z >= 0, z, z - 146096) // 146097
+        doe = z - era * 146097
+        yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+        y = yoe + era * 400
+        doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+        mp = (5 * doy + 2) // 153
+        m = jnp.where(mp < 10, mp + 3, mp - 9)
+        year = jnp.where(m <= 2, y + 1, y)
+        return Vec(year.astype(jnp.int32), T.INT, v.validity)
+
+    def __repr__(self):
+        return f"year({self.children[0]!r})"
+
+
+# ---------------------------------------------------------------------------
+# Predicates (three-valued logic; reference: predicates.scala)
+# ---------------------------------------------------------------------------
+
+class BinaryComparison(Expression):
+    op = "?"
+
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch: Batch) -> Vec:
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        # dictionary-encoded string vs host string literal
+        if isinstance(lv.dtype, T.StringType) or isinstance(rv.dtype, T.StringType):
+            return self._eval_string(lv, rv, batch)
+        out = T.common_type(lv.dtype, rv.dtype)
+        l = _align(lv, out)
+        r = _align(rv, out)
+        return Vec(self._cmp(l, r), T.BOOLEAN, _and_valid(lv.validity, rv.validity))
+
+    def _eval_string(self, lv: Vec, rv: Vec, batch: Batch) -> Vec:
+        lit = None
+        colv = None
+        for a, b in ((lv, rv), (rv, lv)):
+            if a.data is None and a.dictionary is None:
+                lit, colv = a, b
+        if lit is None:
+            # column-vs-column string compare: only EQ/NE via shared dictionary
+            if lv.dictionary is not None and rv.dictionary is not None \
+                    and lv.dictionary.equals(rv.dictionary) \
+                    and type(self) in (EQ, NE):
+                return Vec(self._cmp(lv.data, rv.data), T.BOOLEAN,
+                           _and_valid(lv.validity, rv.validity))
+            raise AnalysisError(
+                f"string comparison {self.op} requires a literal or shared "
+                f"dictionary")
+        # evaluate the comparison on the host dictionary once, then gather
+        lit_expr = self.children[0] if lv is lit else self.children[1]
+        value = lit_expr.value  # type: ignore[attr-defined]
+        table = _dict_compare_table(colv.dictionary, value,
+                                    self.op if colv is lv or type(self) in (EQ, NE)
+                                    else _flip_op(self.op))
+        data = jnp.take(table, jnp.clip(colv.data, 0, len(table) - 1))
+        return Vec(data, T.BOOLEAN, colv.validity)
+
+    def _cmp(self, l, r):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.children[0]!r} {self.op} {self.children[1]!r})"
+
+
+def _flip_op(op: str) -> str:
+    return {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
+
+
+def _dict_compare_table(dictionary: Optional[pa.Array], value: str, op: str):
+    if dictionary is None:
+        raise AnalysisError("string column without dictionary")
+    ops = {"=": pc.equal, "!=": pc.not_equal, "<": pc.less,
+           "<=": pc.less_equal, ">": pc.greater, ">=": pc.greater_equal}
+    mask = ops[op](dictionary, pa.scalar(value)).to_numpy(zero_copy_only=False)
+    return jnp.asarray(np.asarray(mask, dtype=np.bool_))
+
+
+class EQ(BinaryComparison):
+    op = "="
+
+    def _cmp(self, l, r):
+        return l == r
+
+
+class NE(BinaryComparison):
+    op = "!="
+
+    def _cmp(self, l, r):
+        return l != r
+
+
+class LT(BinaryComparison):
+    op = "<"
+
+    def _cmp(self, l, r):
+        return l < r
+
+
+class LE(BinaryComparison):
+    op = "<="
+
+    def _cmp(self, l, r):
+        return l <= r
+
+
+class GT(BinaryComparison):
+    op = ">"
+
+    def _cmp(self, l, r):
+        return l > r
+
+
+class GE(BinaryComparison):
+    op = ">="
+
+    def _cmp(self, l, r):
+        return l >= r
+
+
+class And(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch):
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        data = lv.data & rv.data
+        if lv.validity is None and rv.validity is None:
+            return Vec(data, T.BOOLEAN)
+        # Kleene: false AND null = false
+        lval = lv.validity if lv.validity is not None else True
+        rval = rv.validity if rv.validity is not None else True
+        false_l = (~lv.data) & (jnp.asarray(lval) if lv.validity is not None else True)
+        false_r = (~rv.data) & (jnp.asarray(rval) if rv.validity is not None else True)
+        validity = (jnp.asarray(lval) & jnp.asarray(rval)) | false_l | false_r
+        return Vec(data & validity | jnp.zeros_like(data), T.BOOLEAN, validity)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} AND {self.children[1]!r})"
+
+
+class Or(Expression):
+    def __init__(self, left, right):
+        self.children = (left, right)
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch):
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        data = lv.data | rv.data
+        if lv.validity is None and rv.validity is None:
+            return Vec(data, T.BOOLEAN)
+        lval = lv.validity if lv.validity is not None else True
+        rval = rv.validity if rv.validity is not None else True
+        true_l = lv.data & (jnp.asarray(lval) if lv.validity is not None else True)
+        true_r = rv.data & (jnp.asarray(rval) if rv.validity is not None else True)
+        validity = (jnp.asarray(lval) & jnp.asarray(rval)) | true_l | true_r
+        return Vec(data, T.BOOLEAN, validity)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} OR {self.children[1]!r})"
+
+
+class Not(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        return Vec(~v.data, T.BOOLEAN, v.validity)
+
+    def __repr__(self):
+        return f"(NOT {self.children[0]!r})"
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        self.children = (child,)
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def nullable(self, schema):
+        return False
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.validity is None:
+            return Vec(jnp.zeros(np.shape(v.data) or (1,), dtype=jnp.bool_)
+                       if v.data is not None else jnp.zeros((), jnp.bool_),
+                       T.BOOLEAN)
+        return Vec(~v.validity, T.BOOLEAN)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IS NULL)"
+
+
+class In(Expression):
+    def __init__(self, child: Expression, values: Tuple):
+        self.children = (child,)
+        self.values = tuple(values)
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if isinstance(v.dtype, T.StringType):
+            if v.dictionary is None:
+                raise AnalysisError("IN on string requires dictionary")
+            mask = pc.is_in(v.dictionary,
+                            value_set=pa.array(list(self.values))) \
+                .to_numpy(zero_copy_only=False)
+            table = jnp.asarray(np.asarray(mask, dtype=np.bool_))
+            data = jnp.take(table, jnp.clip(v.data, 0, len(table) - 1))
+            return Vec(data, T.BOOLEAN, v.validity)
+        acc = None
+        for val in self.values:
+            lit = cast_vec(Literal(val).eval_scalar(), v.dtype)
+            hit = v.data == lit.data
+            acc = hit if acc is None else (acc | hit)
+        return Vec(acc, T.BOOLEAN, v.validity)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IN {self.values!r})"
+
+
+class Like(Expression):
+    """LIKE with SQL wildcards, evaluated on the host dictionary then
+    gathered by code — O(|dict|) host work regardless of row count."""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.children = (child,)
+        self.pattern = pattern
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.dictionary is None:
+            raise AnalysisError("LIKE requires a dictionary-encoded string column")
+        mask = pc.match_like(v.dictionary, self.pattern).to_numpy(
+            zero_copy_only=False)
+        table = jnp.asarray(np.asarray(mask, dtype=np.bool_))
+        data = jnp.take(table, jnp.clip(v.data, 0, len(table) - 1))
+        return Vec(data, T.BOOLEAN, v.validity)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} LIKE {self.pattern!r})"
+
+
+class Substring(Expression):
+    """substring(col, start, len) on dictionary strings: rewrites the
+    host dictionary; device codes are unchanged (a dictionary transform)."""
+
+    def __init__(self, child: Expression, start: int, length: int):
+        self.children = (child,)
+        self.start = start
+        self.length = length
+
+    def dtype(self, schema):
+        return T.STRING
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.dictionary is None:
+            raise AnalysisError("substring requires dictionary-encoded strings")
+        new_dict = pc.utf8_slice_codeunits(
+            v.dictionary, start=self.start - 1,
+            stop=self.start - 1 + self.length)
+        # note: codes may now collide in new_dict; group-by re-encodes
+        return Vec(v.data, T.STRING, v.validity, new_dict.combine_chunks()
+                   if isinstance(new_dict, pa.ChunkedArray) else new_dict)
+
+    def __repr__(self):
+        return f"substring({self.children[0]!r},{self.start},{self.length})"
+
+
+class CaseWhen(Expression):
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 otherwise: Optional[Expression] = None):
+        self.branches = [(c, v) for c, v in branches]
+        self.otherwise = otherwise
+        flat: List[Expression] = []
+        for c, v in self.branches:
+            flat += [c, v]
+        if otherwise is not None:
+            flat.append(otherwise)
+        self.children = tuple(flat)
+
+    def dtype(self, schema):
+        dts = [v.dtype(schema) for _, v in self.branches]
+        if self.otherwise is not None:
+            dts.append(self.otherwise.dtype(schema))
+        out = dts[0]
+        for d in dts[1:]:
+            out = T.common_type(out, d)
+        return out
+
+    def eval(self, batch):
+        out_dtype = self.dtype(batch.schema())
+        if self.otherwise is not None:
+            acc = cast_vec(self.otherwise.eval(batch), out_dtype)
+            acc_data, acc_val = acc.data, acc.validity
+        else:
+            acc_data = jnp.zeros((), out_dtype.np_dtype)
+            acc_val = jnp.zeros((), jnp.bool_)
+        for cond, val in reversed(self.branches):
+            cv = cond.eval(batch)
+            vv = cast_vec(val.eval(batch), out_dtype)
+            cond_true = cv.data
+            if cv.validity is not None:
+                cond_true = cond_true & cv.validity
+            acc_data = jnp.where(cond_true, vv.data, acc_data)
+            if vv.validity is not None or acc_val is not None:
+                vval = vv.validity if vv.validity is not None else \
+                    jnp.ones((), jnp.bool_)
+                aval = acc_val if acc_val is not None else jnp.ones((), jnp.bool_)
+                acc_val = jnp.where(cond_true, vval, aval)
+        acc_val = None if acc_val is None else jnp.broadcast_to(
+            acc_val, np.shape(acc_data))
+        return Vec(acc_data, out_dtype, acc_val)
+
+    def __repr__(self):
+        return f"CASE {self.branches!r} ELSE {self.otherwise!r}"

@@ -1,0 +1,91 @@
+"""User-facing expression constructors (the reference's `functions.scala`)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from . import types as T
+from .expr import (CaseWhen, ColumnRef, Expression, ExtractYear, Literal,
+                   date_literal)
+from .expr_agg import AggExpr, Avg, Count, Max, Min, Sum
+
+
+def col(name: str) -> ColumnRef:
+    return ColumnRef(name)
+
+
+def lit(value, dtype: Optional[T.DataType] = None) -> Literal:
+    return Literal(value, dtype)
+
+
+def to_date(s: str) -> Literal:
+    """A DATE literal from 'YYYY-MM-DD'."""
+    return date_literal(s)
+
+
+def decimal_lit(value: Union[int, float, str], scale: int = 2) -> Literal:
+    return Literal(float(value), T.DecimalType(38, scale))
+
+
+def _expr(e) -> Expression:
+    return e if isinstance(e, Expression) else col(e) if isinstance(e, str) \
+        else Literal(e)
+
+
+def sum(e) -> Sum:  # noqa: A001 - mirrors pyspark.sql.functions naming
+    return Sum(_expr(e))
+
+
+def avg(e) -> Avg:
+    return Avg(_expr(e))
+
+
+def count(e="*") -> Count:
+    if e is None or (isinstance(e, str) and e == "*"):
+        return Count(None)
+    return Count(_expr(e))
+
+
+def min(e) -> Min:  # noqa: A001
+    return Min(_expr(e))
+
+
+def max(e) -> Max:  # noqa: A001
+    return Max(_expr(e))
+
+
+def year(e) -> ExtractYear:
+    return ExtractYear(_expr(e))
+
+
+class _WhenBuilder(Expression):
+    """when(cond, val).when(...).otherwise(...) chain (functions.scala when)."""
+
+    def __init__(self, branches):
+        self._branches = branches
+        self.children = ()
+
+    def when(self, cond: Expression, value) -> "_WhenBuilder":
+        return _WhenBuilder(self._branches + [(cond, _expr(value))])
+
+    def otherwise(self, value) -> CaseWhen:
+        return CaseWhen(self._branches, _expr(value))
+
+    def _case(self) -> CaseWhen:
+        return CaseWhen(self._branches, None)
+
+    def dtype(self, schema):
+        return self._case().dtype(schema)
+
+    def nullable(self, schema):
+        return True
+
+    def eval(self, batch):
+        return self._case().eval(batch)
+
+    def references(self):
+        return self._case().references()
+
+
+def when(cond: Expression, value) -> _WhenBuilder:
+    return _WhenBuilder([(cond, _expr(value))])

@@ -1,0 +1,163 @@
+"""Table sources: the host->HBM ingest edge.
+
+Plays the role of the reference's DataSource V2 read stack
+(`connector/read/ScanBuilder` -> `Scan` -> `Batch` with
+`SupportsPushDownFilters` / `SupportsPushDownRequiredColumns`) and of the
+vectorized Parquet reader (`VectorizedParquetRecordReader.java:54`): the
+C++ Arrow/Parquet reader does columnar decode + predicate/column pushdown
+on host, then columns are dictionary-encoded/padded and device_put —
+ingest is the only place bytes cross host->device (SURVEY.md section 2.4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.dataset as pa_dataset
+
+from .. import types as T
+from ..columnar import Batch
+from ..expr import (And, BinaryComparison, ColumnRef, EQ, Expression, GE, GT,
+                    In, IsNull, LE, LT, Literal, NE, Not, Or)
+
+
+def expr_to_arrow(e: Expression):
+    """Convert a pushable predicate to a pyarrow.dataset expression.
+    Returns None when not convertible (the conjunct stays residual)."""
+    if isinstance(e, ColumnRef):
+        return pc.field(e._name)
+    if isinstance(e, Literal):
+        v = e.value
+        if isinstance(e._dtype, T.DateType):
+            import datetime
+            v = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+        return pa.scalar(v) if not isinstance(v, Expression) else None
+    if isinstance(e, BinaryComparison):
+        l = expr_to_arrow(e.children[0])
+        r = expr_to_arrow(e.children[1])
+        if l is None or r is None:
+            return None
+        ops = {EQ: lambda a, b: a == b, NE: lambda a, b: a != b,
+               LT: lambda a, b: a < b, LE: lambda a, b: a <= b,
+               GT: lambda a, b: a > b, GE: lambda a, b: a >= b}
+        return ops[type(e)](l, r)
+    if isinstance(e, And):
+        l, r = (expr_to_arrow(c) for c in e.children)
+        return None if l is None or r is None else l & r
+    if isinstance(e, Or):
+        l, r = (expr_to_arrow(c) for c in e.children)
+        return None if l is None or r is None else l | r
+    if isinstance(e, Not):
+        c = expr_to_arrow(e.children[0])
+        return None if c is None else ~c
+    if isinstance(e, In):
+        c = expr_to_arrow(e.children[0])
+        return None if c is None else c.isin(list(e.values))
+    if isinstance(e, IsNull):
+        c = expr_to_arrow(e.children[0])
+        return None if c is None else c.is_null()
+    return None
+
+
+class TableSource:
+    name: str = "<source>"
+
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def can_push(self, e: Expression) -> bool:
+        return False
+
+    def load(self, required_columns: Optional[Sequence[str]],
+             pushed_filters: Sequence[Expression]) -> Batch:
+        raise NotImplementedError
+
+    def estimated_rows(self) -> Optional[int]:
+        return None
+
+
+def _arrow_schema_to_engine(schema: pa.Schema) -> T.Schema:
+    from ..columnar import _ARROW_TO_DTYPE
+    fields = []
+    for f in schema:
+        at = f.type
+        if pa.types.is_string(at) or pa.types.is_large_string(at) or \
+                pa.types.is_dictionary(at):
+            dt: T.DataType = T.STRING
+        elif pa.types.is_decimal(at):
+            dt = T.DecimalType(at.precision, at.scale)
+        elif pa.types.is_timestamp(at):
+            dt = T.TIMESTAMP
+        elif at == pa.date32():
+            dt = T.DATE
+        else:
+            dt = _ARROW_TO_DTYPE.get(at)
+            if dt is None:
+                raise TypeError(f"unsupported arrow type {at} ({f.name})")
+        fields.append(T.Field(f.name, dt, f.nullable))
+    return T.Schema(fields)
+
+
+class ArrowTableSource(TableSource):
+    """In-memory table (the reference's LocalRelation / InMemoryRelation)."""
+
+    def __init__(self, name: str, table: pa.Table):
+        self.name = name
+        self.table = table
+
+    def schema(self) -> T.Schema:
+        return _arrow_schema_to_engine(self.table.schema)
+
+    def can_push(self, e: Expression) -> bool:
+        return expr_to_arrow(e) is not None
+
+    def estimated_rows(self):
+        return self.table.num_rows
+
+    def load(self, required_columns, pushed_filters) -> Batch:
+        t = self.table
+        for f in pushed_filters:
+            ae = expr_to_arrow(f)
+            if ae is not None:
+                t = t.filter(ae)
+        if required_columns is not None:
+            t = t.select(list(required_columns))
+        return Batch.from_arrow(t)
+
+
+class ParquetSource(TableSource):
+    """Parquet directory/file via the C++ Arrow dataset reader: column
+    pruning + row-group predicate skipping happen in native code before
+    any bytes reach the device."""
+
+    def __init__(self, path: str, name: Optional[str] = None):
+        self.path = path
+        self.name = name or os.path.basename(path).split(".")[0]
+        self._dataset = pa_dataset.dataset(path, format="parquet")
+
+    def schema(self) -> T.Schema:
+        return _arrow_schema_to_engine(self._dataset.schema)
+
+    def can_push(self, e: Expression) -> bool:
+        return expr_to_arrow(e) is not None
+
+    def estimated_rows(self):
+        try:
+            return sum(f.metadata.num_rows for f in self._dataset.get_fragments())
+        except Exception:
+            return None
+
+    def load(self, required_columns, pushed_filters) -> Batch:
+        ae = None
+        for f in pushed_filters:
+            e = expr_to_arrow(f)
+            if e is not None:
+                ae = e if ae is None else (ae & e)
+        t = self._dataset.to_table(
+            columns=list(required_columns) if required_columns is not None else None,
+            filter=ae)
+        return Batch.from_arrow(t)

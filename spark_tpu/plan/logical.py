@@ -1,0 +1,304 @@
+"""Logical plan nodes.
+
+The analogs of the reference's `plans/logical/basicLogicalOperators.scala`
+(Project/Filter/Aggregate/Join/Sort/Limit/Range/Union). Plans are
+immutable trees; `schema()` performs type resolution (the Analyzer's
+job in `analysis/Analyzer.scala:172` — here resolution is eager and
+name-based because the DataFrame API builds plans bottom-up, with
+`AnalysisError` raised on unresolvable names/types).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..expr import (AnalysisError, Expression, SortOrder, structurally_equal)
+from ..expr_agg import AggExpr
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def map_children(self, f: Callable[["LogicalPlan"], "LogicalPlan"]):
+        if not self.children:
+            return self
+        new = copy.copy(self)
+        new.children = tuple(f(c) for c in self.children)
+        return new
+
+    def transform_up(self, f) -> "LogicalPlan":
+        node = self.map_children(lambda c: c.transform_up(f))
+        out = f(node)
+        return node if out is None else out
+
+    def transform_down(self, f) -> "LogicalPlan":
+        out = f(self)
+        node = self if out is None else out
+        return node.map_children(lambda c: c.transform_down(f))
+
+    def output_names(self) -> List[str]:
+        return self.schema().names
+
+    def tree_string(self, depth: int = 0) -> str:
+        line = "  " * depth + self.simple_string()
+        return "\n".join([line] + [c.tree_string(depth + 1) for c in self.children])
+
+    def simple_string(self) -> str:
+        return type(self).__name__
+
+    def same_result(self, other: "LogicalPlan") -> bool:
+        """Structural plan equality for rule tests (reference: PlanTest.comparePlans)."""
+        if type(self) is not type(other) or len(self.children) != len(other.children):
+            return False
+        sa = {k: v for k, v in self.__dict__.items() if k != "children"}
+        sb = {k: v for k, v in other.__dict__.items() if k != "children"}
+        for k in sa:
+            if not _attr_eq(sa.get(k), sb.get(k)):
+                return False
+        return all(a.same_result(b) for a, b in zip(self.children, other.children))
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+def _attr_eq(a, b) -> bool:
+    if isinstance(a, Expression) and isinstance(b, Expression):
+        return structurally_equal(a, b)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_attr_eq(x, y) for x, y in zip(a, b))
+    if isinstance(a, AggExpr) and isinstance(b, AggExpr):
+        return (a.out_name == b.out_name
+                and type(a.func) is type(b.func)
+                and (a.func.child is None) == (b.func.child is None)
+                and (a.func.child is None
+                     or structurally_equal(a.func.child, b.func.child)))
+    try:
+        return bool(a == b)
+    except Exception:
+        return a is b
+
+
+class LeafPlan(LogicalPlan):
+    pass
+
+
+class Range(LeafPlan):
+    """spark.range analog (reference: org.apache.spark.sql.execution.basicPhysicalOperators RangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1):
+        self.start = start
+        self.end = end
+        self.step = step
+        self.children = ()
+
+    def num_rows(self) -> int:
+        return max(0, -(-(self.end - self.start) // self.step))
+
+    def schema(self) -> T.Schema:
+        return T.Schema([T.Field("id", T.LONG, nullable=False)])
+
+    def simple_string(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Scan(LeafPlan):
+    """Scan of a cataloged table (V1 FileSourceScanExec / InMemoryScan analog).
+
+    `source` is a TableSource (io.catalog) that knows its schema and can
+    produce device batches, optionally with column pruning + predicate
+    pushdown (the `SupportsPushDownFilters/RequiredColumns` mixins of the
+    reference's DataSource V2 `connector/read/` API).
+    """
+
+    def __init__(self, source, required_columns: Optional[Sequence[str]] = None,
+                 pushed_filters: Sequence[Expression] = ()):
+        self.source = source
+        self.required_columns = (tuple(required_columns)
+                                 if required_columns is not None else None)
+        self.pushed_filters = tuple(pushed_filters)
+        self.children = ()
+
+    def schema(self) -> T.Schema:
+        full = self.source.schema()
+        if self.required_columns is None:
+            return full
+        return T.Schema([full.field(n) for n in self.required_columns])
+
+    def simple_string(self):
+        cols = "*" if self.required_columns is None else ",".join(self.required_columns)
+        f = f" pushed={list(self.pushed_filters)!r}" if self.pushed_filters else ""
+        return f"Scan({self.source.name}, [{cols}]{f})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: Sequence[Expression]):
+        self.children = (child,)
+        self.exprs = tuple(exprs)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.Schema:
+        cs = self.child.schema()
+        return T.Schema([T.Field(e.name(), e.dtype(cs), e.nullable(cs))
+                         for e in self.exprs])
+
+    def simple_string(self):
+        return f"Project({list(self.exprs)!r})"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: Expression):
+        self.children = (child,)
+        self.condition = condition
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.Schema:
+        cond_t = self.condition.dtype(self.child.schema())
+        if not isinstance(cond_t, T.BooleanType):
+            raise AnalysisError(f"filter condition must be boolean, got {cond_t!r}")
+        return self.child.schema()
+
+    def simple_string(self):
+        return f"Filter({self.condition!r})"
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, child: LogicalPlan, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[AggExpr]):
+        self.children = (child,)
+        self.group_exprs = tuple(group_exprs)
+        self.agg_exprs = tuple(agg_exprs)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.Schema:
+        cs = self.child.schema()
+        fields = [T.Field(g.name(), g.dtype(cs), g.nullable(cs))
+                  for g in self.group_exprs]
+        for a in self.agg_exprs:
+            fields.append(T.Field(a.out_name, a.func.result_type(cs),
+                                  a.func.result_nullable(cs)))
+        return T.Schema(fields)
+
+    def simple_string(self):
+        return (f"Aggregate(groups={list(self.group_exprs)!r}, "
+                f"aggs={list(self.agg_exprs)!r})")
+
+
+JOIN_TYPES = ("inner", "left", "right", "left_semi", "left_anti")
+
+
+class Join(LogicalPlan):
+    """Equi-join on key expression pairs (reference: logical Join +
+    ExtractEquiJoinKeys). `condition` is an optional residual non-equi
+    predicate applied post-match."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 how: str = "inner", condition: Optional[Expression] = None):
+        if how not in JOIN_TYPES:
+            raise AnalysisError(f"unsupported join type {how!r}")
+        if len(left_keys) != len(right_keys) or not left_keys:
+            raise AnalysisError("join requires matching, non-empty key lists")
+        self.children = (left, right)
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.how = how
+        self.condition = condition
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def schema(self) -> T.Schema:
+        ls = self.left.schema()
+        if self.how in ("left_semi", "left_anti"):
+            return ls
+        rs = self.right.schema()
+        fields = list(ls.fields)
+        left_names = {f.name for f in fields}
+        for f in rs.fields:
+            name = f.name
+            while name in left_names:
+                name = name + "_r"
+            right_nullable = f.nullable or self.how == "left"
+            fields.append(T.Field(name, f.dtype, right_nullable))
+            left_names.add(name)
+        if self.how == "right":
+            fields = [T.Field(f.name, f.dtype,
+                              f.nullable or ls.field(f.name).nullable
+                              if f.name in ls.names else f.nullable)
+                      for f in fields]
+        return T.Schema(fields)
+
+    def simple_string(self):
+        return (f"Join({self.how}, {list(self.left_keys)!r} = "
+                f"{list(self.right_keys)!r}"
+                + (f", cond={self.condition!r}" if self.condition is not None else "")
+                + ")")
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: Sequence[SortOrder]):
+        self.children = (child,)
+        self.orders = tuple(orders)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.Schema:
+        return self.child.schema()
+
+    def simple_string(self):
+        return f"Sort({list(self.orders)!r})"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        self.children = (child,)
+        self.n = n
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.Schema:
+        return self.child.schema()
+
+    def simple_string(self):
+        return f"Limit({self.n})"
+
+
+class Union(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        ls, rs = left.schema(), right.schema()
+        if len(ls) != len(rs):
+            raise AnalysisError("UNION requires same column count")
+        self.children = (left, right)
+
+    def schema(self) -> T.Schema:
+        ls = self.children[0].schema()
+        rs = self.children[1].schema()
+        fields = []
+        for a, b in zip(ls.fields, rs.fields):
+            fields.append(T.Field(a.name, T.common_type(a.dtype, b.dtype),
+                                  a.nullable or b.nullable))
+        return T.Schema(fields)

@@ -1,0 +1,232 @@
+"""Logical optimizer rules.
+
+The round-1 subset of the reference's `optimizer/Optimizer.scala:42`
+default batches: filter combination, filter pushdown through projections
+and into scans, column pruning into scans, and constant folding.
+Every rule is plan->plan and covered by plan==plan tests (the pattern of
+the reference's `PlanTest.comparePlans`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..columnar import Batch as ColBatch
+from ..expr import (Alias, And, ColumnRef, Expression, Literal)
+from .logical import (Aggregate, Filter, Join, Limit, LogicalPlan, Project,
+                      Range, Scan, Sort, Union)
+from .rules import Batch, Rule, RuleExecutor
+
+
+class CombineFilters(Rule):
+    name = "CombineFilters"
+
+    def apply(self, plan):
+        def f(node):
+            if isinstance(node, Filter) and isinstance(node.child, Filter):
+                inner = node.child
+                return Filter(inner.child, And(inner.condition, node.condition))
+            return node
+        return plan.transform_up(f)
+
+
+def _substitute(expr: Expression, mapping: dict) -> Expression:
+    def f(node):
+        if isinstance(node, ColumnRef) and node._name in mapping:
+            return mapping[node._name]
+        return node
+    return expr.transform_up(f)
+
+
+class PushFilterThroughProject(Rule):
+    name = "PushFilterThroughProject"
+
+    def apply(self, plan):
+        def f(node):
+            if isinstance(node, Filter) and isinstance(node.child, Project):
+                proj = node.child
+                mapping = {}
+                for e in proj.exprs:
+                    if isinstance(e, Alias):
+                        mapping[e.name()] = e.child
+                    elif isinstance(e, ColumnRef):
+                        mapping[e.name()] = e
+                cond = _substitute(node.condition, mapping)
+                try:
+                    cond.dtype(proj.child.schema())
+                except Exception:
+                    return node  # references a computed column we can't inline
+                return Project(Filter(proj.child, cond), proj.exprs)
+            return node
+        return plan.transform_up(f)
+
+
+class PushFilterIntoScan(Rule):
+    """Hand conjuncts to the source (reference: DataSource V2
+    `SupportsPushDownFilters` / `V2ScanRelationPushDown`). The source keeps
+    what it can use for IO skipping; everything is still re-applied as a
+    residual filter for correctness (same contract as Spark's parquet
+    row-group pushdown)."""
+
+    name = "PushFilterIntoScan"
+
+    def apply(self, plan):
+        def f(node):
+            if isinstance(node, Filter) and isinstance(node.child, Scan):
+                scan = node.child
+                conjuncts = _split_conjuncts(node.condition)
+                new_pushed = [c for c in conjuncts
+                              if scan.source.can_push(c)
+                              and not any(c is p for p in scan.pushed_filters)
+                              and not any(_expr_eq(c, p) for p in scan.pushed_filters)]
+                if not new_pushed:
+                    return node
+                new_scan = Scan(scan.source, scan.required_columns,
+                                tuple(scan.pushed_filters) + tuple(new_pushed))
+                return Filter(new_scan, node.condition)
+            return node
+        return plan.transform_up(f)
+
+
+def _expr_eq(a, b):
+    from ..expr import structurally_equal
+    return structurally_equal(a, b)
+
+
+def _split_conjuncts(e: Expression) -> List[Expression]:
+    if isinstance(e, And):
+        return _split_conjuncts(e.children[0]) + _split_conjuncts(e.children[1])
+    return [e]
+
+
+class PruneColumns(Rule):
+    """Top-down required-column propagation narrowing Scan nodes
+    (reference: `ColumnPruning` + `V2ScanRelationPushDown` column pruning)."""
+
+    name = "PruneColumns"
+
+    def apply(self, plan):
+        return self._prune(plan, None)
+
+    def _prune(self, node: LogicalPlan, needed: Optional[Set[str]]):
+        if isinstance(node, Scan):
+            if needed is None:
+                return node
+            avail = node.source.schema().names
+            for f in node.pushed_filters:
+                needed = needed | f.references()
+            cols = tuple(n for n in avail if n in needed)
+            if node.required_columns is not None and \
+                    set(node.required_columns) == set(cols):
+                return node
+            return Scan(node.source, cols, node.pushed_filters)
+        if isinstance(node, Project):
+            child_needed = set()
+            for e in node.exprs:
+                child_needed |= e.references()
+            return Project(self._prune(node.child, child_needed), node.exprs)
+        if isinstance(node, Filter):
+            child_needed = None if needed is None else \
+                needed | node.condition.references()
+            return Filter(self._prune(node.child, child_needed), node.condition)
+        if isinstance(node, Aggregate):
+            child_needed = set()
+            for g in node.group_exprs:
+                child_needed |= g.references()
+            for a in node.agg_exprs:
+                child_needed |= a.func.references()
+            return Aggregate(self._prune(node.child, child_needed),
+                             node.group_exprs, node.agg_exprs)
+        if isinstance(node, Join):
+            left_names = set(node.left.schema().names)
+            right_names = set(node.right.schema().names)
+            refs = set()
+            for k in node.left_keys + node.right_keys:
+                refs |= k.references()
+            if node.condition is not None:
+                refs |= node.condition.references()
+            if needed is None:
+                ln = rn = None
+            else:
+                want = needed | refs
+                ln = {n for n in want if n in left_names}
+                rn = {n for n in want if n in right_names}
+            new = copy_join(node, self._prune(node.left, ln),
+                            self._prune(node.right, rn))
+            return new
+        if isinstance(node, Sort):
+            child_needed = None
+            if needed is not None:
+                child_needed = set(needed)
+                for o in node.orders:
+                    child_needed |= o.child.references()
+            return Sort(self._prune(node.child, child_needed), node.orders)
+        if isinstance(node, Limit):
+            return Limit(self._prune(node.child, needed), node.n)
+        if isinstance(node, Union):
+            return Union(self._prune(node.children[0], None),
+                         self._prune(node.children[1], None))
+        return node.map_children(lambda c: self._prune(c, None))
+
+
+def copy_join(j: Join, left, right) -> Join:
+    return Join(left, right, j.left_keys, j.right_keys, j.how, j.condition)
+
+
+_EMPTY_BATCH = None
+
+
+def _empty_batch():
+    global _EMPTY_BATCH
+    if _EMPTY_BATCH is None:
+        _EMPTY_BATCH = ColBatch({}, None)
+    return _EMPTY_BATCH
+
+
+class ConstantFolding(Rule):
+    name = "ConstantFolding"
+
+    def apply(self, plan):
+        def fold_expr(e: Expression) -> Expression:
+            def f(node):
+                if (node.foldable() and not isinstance(node, Literal)
+                        and not isinstance(node, Alias)):
+                    try:
+                        dt = node.dtype(T.Schema([]))
+                    except Exception:
+                        return node
+                    if isinstance(dt, (T.StringType, T.DecimalType)):
+                        return node
+                    try:
+                        v = node.eval(_empty_batch())
+                    except Exception:
+                        return node
+                    if v.validity is not None:
+                        return node
+                    val = np.asarray(v.data).item()
+                    return Literal(val, dt)
+                return node
+            return e.transform_up(f)
+
+        def f(node):
+            if isinstance(node, Project):
+                return Project(node.child, [fold_expr(e) for e in node.exprs])
+            if isinstance(node, Filter):
+                return Filter(node.child, fold_expr(node.condition))
+            return node
+        return plan.transform_up(f)
+
+
+def default_optimizer() -> RuleExecutor:
+    return RuleExecutor([
+        Batch("Filter pushdown", [
+            CombineFilters(),
+            PushFilterThroughProject(),
+            PushFilterIntoScan(),
+        ]),
+        Batch("Fold", [ConstantFolding()], strategy="once"),
+        Batch("Prune", [PruneColumns()], strategy="once"),
+    ])

@@ -1,0 +1,640 @@
+"""Physical plan: partitioning algebra + executable operators.
+
+The partitioning algebra mirrors the reference's
+`plans/physical/partitioning.scala` (`Distribution:31`,
+`HashPartitioning:212`); operators mirror `execution/SparkPlan.scala`
+(`requiredChildDistribution`, `outputPartitioning`) but `compute` builds a
+*traced* jnp program over whole Batches instead of an RDD of row
+iterators — the executor jits the composed tree, so XLA fusion plays the
+role of `WholeStageCodegenExec.scala:626`.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar import Batch, Column, bucket_capacity
+from ..config import Conf
+from ..expr import (AnalysisError, Expression, SortOrder, Vec)
+from ..expr_agg import AggExpr
+from ..execution import aggregate as agg_kernels
+from ..execution import join as join_kernels
+from ..execution import sort as sort_kernels
+
+
+# ---------------------------------------------------------------------------
+# Partitioning algebra (reference: partitioning.scala)
+# ---------------------------------------------------------------------------
+
+class Distribution:
+    pass
+
+
+@dataclass(frozen=True)
+class UnspecifiedDistribution(Distribution):
+    pass
+
+
+@dataclass(frozen=True)
+class AllTuples(Distribution):
+    """All rows co-located in one logical partition."""
+
+
+@dataclass(frozen=True)
+class ClusteredDistribution(Distribution):
+    keys: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class BroadcastDistribution(Distribution):
+    """Full copy on every shard."""
+
+
+class Partitioning:
+    num_partitions: int = 1
+
+    def satisfies(self, dist: Distribution) -> bool:
+        if isinstance(dist, UnspecifiedDistribution):
+            return True
+        return False
+
+
+@dataclass(frozen=True)
+class SinglePartition(Partitioning):
+    num_partitions: int = 1
+
+    def satisfies(self, dist):
+        return not isinstance(dist, BroadcastDistribution)
+
+
+@dataclass(frozen=True)
+class HashPartitioning(Partitioning):
+    keys: Tuple[str, ...] = ()
+    num_partitions: int = 1
+
+    def satisfies(self, dist):
+        if isinstance(dist, UnspecifiedDistribution):
+            return True
+        if isinstance(dist, ClusteredDistribution):
+            return set(self.keys).issubset(set(dist.keys)) and len(self.keys) > 0
+        return False
+
+
+@dataclass(frozen=True)
+class Replicated(Partitioning):
+    num_partitions: int = 1
+
+    def satisfies(self, dist):
+        return isinstance(dist, (UnspecifiedDistribution, BroadcastDistribution))
+
+
+@dataclass(frozen=True)
+class UnknownPartitioning(Partitioning):
+    num_partitions: int = 1
+
+
+# ---------------------------------------------------------------------------
+# Execution context
+# ---------------------------------------------------------------------------
+
+class ExecContext:
+    """Per-execution state threaded through `compute` calls: conf, runtime
+    flags (traced scalars surfaced to the host, e.g. duplicate-build-key
+    detection), and per-operator metrics (the SQLMetrics analog)."""
+
+    def __init__(self, conf: Conf):
+        self.conf = conf
+        self.flags: Dict[str, object] = {}
+        self.metrics: Dict[str, object] = {}
+
+    def add_flag(self, name: str, value) -> None:
+        if name in self.flags:
+            self.flags[name] = self.flags[name] | value
+        else:
+            self.flags[name] = value
+
+    def add_metric(self, name: str, value) -> None:
+        self.metrics[name] = value
+
+
+# ---------------------------------------------------------------------------
+# Operators
+# ---------------------------------------------------------------------------
+
+class PhysicalPlan:
+    children: Tuple["PhysicalPlan", ...] = ()
+
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def output_partitioning(self) -> Partitioning:
+        return SinglePartition()
+
+    def required_child_distributions(self) -> List[Distribution]:
+        return [UnspecifiedDistribution() for _ in self.children]
+
+    def compute(self, ctx: ExecContext, inputs: List[Batch]) -> Batch:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        """Stable structural fingerprint for the compiled-stage cache
+        (plays the role of the Janino cache key in CodeGenerator.scala:1435)."""
+        parts = [self.simple_string()]
+        for c in self.children:
+            parts.append(c.describe())
+        return "(" + " ".join(parts) + ")"
+
+    def simple_string(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, depth: int = 0) -> str:
+        line = "  " * depth + self.simple_string()
+        return "\n".join([line] + [c.tree_string(depth + 1)
+                                   for c in self.children])
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+class LeafExec(PhysicalPlan):
+    """Leaves either synthesize data in-trace (Range) or consume a host
+    -loaded Batch passed as a jit argument (Scan)."""
+
+    #: True when the executor must load and pass a Batch argument
+    needs_input = False
+
+    def load(self):  # host side
+        raise NotImplementedError
+
+
+class RangeExec(LeafExec):
+    def __init__(self, start: int, end: int, step: int = 1):
+        self.start, self.end, self.step = start, end, step
+        self.children = ()
+
+    def schema(self):
+        return T.Schema([T.Field("id", T.LONG, nullable=False)])
+
+    def num_rows(self) -> int:
+        return max(0, -(-(self.end - self.start) // self.step))
+
+    def compute(self, ctx, inputs):
+        n = self.num_rows()
+        cap = bucket_capacity(n)
+        ids = self.start + self.step * jnp.arange(cap, dtype=jnp.int64)
+        sel = jnp.arange(cap) < n
+        return Batch({"id": Column(ids, T.LONG)}, sel)
+
+    def simple_string(self):
+        return f"RangeExec({self.start},{self.end},{self.step})"
+
+
+class ScanExec(LeafExec):
+    needs_input = True
+
+    def __init__(self, source, required_columns, pushed_filters):
+        self.source = source
+        self.required_columns = required_columns
+        self.pushed_filters = tuple(pushed_filters)
+        self.children = ()
+
+    def schema(self):
+        full = self.source.schema()
+        if self.required_columns is None:
+            return full
+        return T.Schema([full.field(n) for n in self.required_columns])
+
+    def load(self) -> Batch:
+        return self.source.load(self.required_columns, self.pushed_filters)
+
+    def compute(self, ctx, inputs):
+        # the executor substitutes the loaded batch
+        raise RuntimeError("ScanExec.compute is handled by the executor")
+
+    def simple_string(self):
+        cols = "*" if self.required_columns is None else \
+            ",".join(self.required_columns)
+        return (f"ScanExec({self.source.name},[{cols}],"
+                f"pushed={[repr(f) for f in self.pushed_filters]})")
+
+
+class UnaryExec(PhysicalPlan):
+    @property
+    def child(self) -> PhysicalPlan:
+        return self.children[0]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
+
+
+class ProjectExec(UnaryExec):
+    def __init__(self, child: PhysicalPlan, exprs: Sequence[Expression]):
+        self.children = (child,)
+        self.exprs = tuple(exprs)
+
+    def schema(self):
+        cs = self.child.schema()
+        return T.Schema([T.Field(e.name(), e.dtype(cs), e.nullable(cs))
+                         for e in self.exprs])
+
+    def compute(self, ctx, inputs):
+        batch = inputs[0]
+        cap = batch.capacity
+        cols = {}
+        for e in self.exprs:
+            v = e.eval(batch)
+            data = v.data
+            if data is None:
+                raise AnalysisError(f"cannot project host-only value {e!r}")
+            if np.ndim(data) == 0:
+                data = jnp.broadcast_to(data, (cap,))
+            validity = v.validity
+            if validity is not None and np.ndim(validity) == 0:
+                validity = jnp.broadcast_to(validity, (cap,))
+            cols[e.name()] = Column(data, v.dtype, validity, v.dictionary)
+        return Batch(cols, batch.selection)
+
+    def simple_string(self):
+        return f"ProjectExec({[repr(e) for e in self.exprs]})"
+
+
+class FilterExec(UnaryExec):
+    def __init__(self, child: PhysicalPlan, condition: Expression):
+        self.children = (child,)
+        self.condition = condition
+
+    def schema(self):
+        return self.child.schema()
+
+    def compute(self, ctx, inputs):
+        batch = inputs[0]
+        v = self.condition.eval(batch)
+        keep = v.data
+        if v.validity is not None:
+            keep = keep & v.validity  # NULL predicate -> drop row
+        if np.ndim(keep) == 0:
+            keep = jnp.broadcast_to(keep, (batch.capacity,))
+        sel = keep if batch.selection is None else (batch.selection & keep)
+        return batch.with_selection(sel)
+
+    def simple_string(self):
+        return f"FilterExec({self.condition!r})"
+
+
+class HashAggregateExec(UnaryExec):
+    """Trace-time choice between dense-domain scatter aggregation and the
+    sort-based general path (see execution/aggregate.py). `mode` follows
+    the reference's partial/final split (`AggUtils.scala`):
+
+    - complete: update + reduce + finalize in one node;
+    - partial:  update + reduce, outputs raw accumulator columns;
+    - final:    re-reduces accumulator columns by key, then finalizes.
+    """
+
+    def __init__(self, child: PhysicalPlan, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[AggExpr], mode: str = "complete",
+                 est_groups: Optional[int] = None):
+        assert mode in ("complete", "partial", "final")
+        self.children = (child,)
+        self.group_exprs = tuple(group_exprs)
+        self.agg_exprs = tuple(agg_exprs)
+        self.mode = mode
+        self.est_groups = est_groups
+
+    def _child_schema_for_types(self) -> T.Schema:
+        cs = self.child.schema()
+        if self.mode == "final":
+            # accumulator dtypes are schema-independent; group types come
+            # from the partial output schema
+            return cs
+        return cs
+
+    def _acc_col_name(self, i: int, j: int, spec) -> str:
+        return f"__acc_{i}_{j}_{spec.suffix}"
+
+    def schema(self):
+        cs = self.child.schema()
+        fields = [T.Field(g.name(), g.dtype(cs), g.nullable(cs))
+                  for g in self.group_exprs]
+        if self.mode == "partial":
+            base = self._base_schema()
+            for i, a in enumerate(self.agg_exprs):
+                for j, spec in enumerate(a.func.accumulators(base)):
+                    fields.append(T.Field(
+                        self._acc_col_name(i, j, spec),
+                        _np_to_logical(spec.np_dtype), False))
+        else:
+            base = self._base_schema()
+            for a in self.agg_exprs:
+                fields.append(T.Field(a.out_name, a.func.result_type(base),
+                                      a.func.result_nullable(base)))
+        return T.Schema(fields)
+
+    def _base_schema(self) -> T.Schema:
+        """Schema the aggregate functions' children resolve against: the
+        pre-aggregation input schema (threaded through partial->final)."""
+        node: PhysicalPlan = self
+        while isinstance(node, (HashAggregateExec, ExchangeExec)):
+            node = node.children[0]
+        return node.schema()
+
+    def compute(self, ctx, inputs):
+        batch = inputs[0]
+        base = self._base_schema()
+        sel = batch.selection
+
+        key_vecs = [g.eval(batch) for g in self.group_exprs]
+        if self.mode == "final":
+            specs = [a.func.accumulators(base) for a in self.agg_exprs]
+            contribs = []
+            for i, a in enumerate(self.agg_exprs):
+                row = []
+                for j, spec in enumerate(specs[i]):
+                    col = batch.columns[self._acc_col_name(i, j, spec)]
+                    data = col.data
+                    if sel is not None:
+                        data = jnp.where(sel, data, jnp.asarray(spec.neutral))
+                    row.append(data)
+                contribs.append(row)
+        else:
+            specs = [a.func.accumulators(base) for a in self.agg_exprs]
+            contribs = [a.func.update(batch, sel) for a in self.agg_exprs]
+
+        domains = [agg_kernels.key_domain(g, v)
+                   for g, v in zip(self.group_exprs, key_vecs)]
+        max_domain = int(ctx.conf.get("spark_tpu.sql.aggregate.maxDirectDomain"))
+        use_direct = (all(d is not None for d in domains)
+                      and all(v.validity is None for v in key_vecs)
+                      and int(np.prod([d for d in domains]or [1])) <= max_domain)
+
+        cs = self.child.schema()
+        if use_direct:
+            key_arrays, accs, occupied = agg_kernels.direct_aggregate(
+                key_vecs, domains, contribs, specs, sel)
+            key_valids = [None] * len(key_arrays)
+        else:
+            key_arrays, key_valids, accs, occupied = agg_kernels.sort_aggregate(
+                key_vecs, contribs, specs, sel, batch.capacity,
+                num_segments=self.est_groups and bucket_capacity(self.est_groups))
+
+        if not self.group_exprs:
+            # global aggregate: exactly one output row, always present
+            occupied = jnp.ones((1,), jnp.bool_)
+            key_arrays = []
+            key_valids = []
+            accs = [[acc[:1] for acc in row] for row in accs]
+
+        cols: Dict[str, Column] = {}
+        for g, vec, arr, kv in zip(self.group_exprs, key_vecs, key_arrays,
+                                   key_valids):
+            cols[g.name()] = Column(arr, vec.dtype, kv, vec.dictionary)
+
+        if self.mode == "partial":
+            for i, a in enumerate(self.agg_exprs):
+                for j, spec in enumerate(specs[i]):
+                    cols[self._acc_col_name(i, j, spec)] = Column(
+                        accs[i][j], _np_to_logical(spec.np_dtype))
+        else:
+            for i, a in enumerate(self.agg_exprs):
+                data, validity = a.func.device_finalize(accs[i], base)
+                cols[a.out_name] = Column(
+                    data, a.func.result_type(base), validity)
+        ctx.add_metric(f"agg_groups", jnp.sum(occupied.astype(jnp.int32)))
+        return Batch(cols, occupied)
+
+    def output_partitioning(self):
+        if not self.group_exprs:
+            return SinglePartition()
+        return self.child.output_partitioning()
+
+    def required_child_distributions(self):
+        if self.mode in ("complete", "final"):
+            if not self.group_exprs:
+                return [AllTuples()]
+            return [ClusteredDistribution(tuple(g.name()
+                                                for g in self.group_exprs))]
+        return [UnspecifiedDistribution()]
+
+    def simple_string(self):
+        return (f"HashAggregateExec(mode={self.mode}, "
+                f"groups={[repr(g) for g in self.group_exprs]}, "
+                f"aggs={[repr(a) for a in self.agg_exprs]})")
+
+
+def _np_to_logical(np_dtype) -> T.DataType:
+    m = {np.dtype(np.int64): T.LONG, np.dtype(np.float64): T.DOUBLE,
+         np.dtype(np.int32): T.INT, np.dtype(np.float32): T.FLOAT,
+         np.dtype(np.bool_): T.BOOLEAN, np.dtype(np.int16): T.SHORT,
+         np.dtype(np.int8): T.BYTE}
+    return m[np.dtype(np_dtype)]
+
+
+class SortExec(UnaryExec):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+        self.children = (child,)
+        self.orders = tuple(orders)
+
+    def schema(self):
+        return self.child.schema()
+
+    def required_child_distributions(self):
+        # global sort: all rows in one logical partition (range partitioning
+        # lands with the multi-chip exchange)
+        return [AllTuples()]
+
+    def compute(self, ctx, inputs):
+        batch = inputs[0]
+        perm, n_valid = sort_kernels.sort_permutation(batch, self.orders)
+        return sort_kernels.apply_permutation(batch, perm, n_valid)
+
+    def simple_string(self):
+        return f"SortExec({[repr(o) for o in self.orders]})"
+
+
+class LimitExec(UnaryExec):
+    def __init__(self, child: PhysicalPlan, n: int):
+        self.children = (child,)
+        self.n = n
+
+    def schema(self):
+        return self.child.schema()
+
+    def required_child_distributions(self):
+        return [AllTuples()]
+
+    def compute(self, ctx, inputs):
+        batch = inputs[0]
+        sel = batch.selection_mask()
+        keep = jnp.cumsum(sel.astype(jnp.int32)) <= self.n
+        return batch.with_selection(sel & keep)
+
+    def simple_string(self):
+        return f"LimitExec({self.n})"
+
+
+class JoinExec(PhysicalPlan):
+    """Sorted-build binary-search equi-join (see execution/join.py).
+    Build side = right child. Requires unique build keys (FK-join); a
+    traced `dup` flag is surfaced for the executor to verify."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 how: str, condition: Optional[Expression],
+                 out_schema: T.Schema):
+        self.children = (left, right)
+        self.left_keys = tuple(left_keys)
+        self.right_keys = tuple(right_keys)
+        self.how = how
+        self.condition = condition
+        self._schema = out_schema
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def schema(self):
+        return self._schema
+
+    def required_child_distributions(self):
+        lk = tuple(k.name() for k in self.left_keys)
+        rk = tuple(k.name() for k in self.right_keys)
+        return [ClusteredDistribution(lk), ClusteredDistribution(rk)]
+
+    def output_partitioning(self):
+        return self.left.output_partitioning()
+
+    def compute(self, ctx, inputs):
+        probe_batch, build_batch = inputs
+        if len(self.left_keys) != 1:
+            # pack multiple int keys into one 64-bit key
+            lk = _pack_keys([k.eval(probe_batch) for k in self.left_keys])
+            rk = _pack_keys([k.eval(build_batch) for k in self.right_keys])
+        else:
+            lk = self.left_keys[0].eval(probe_batch)
+            rk = self.right_keys[0].eval(build_batch)
+        keys_s, perm, n_valid, valid_s, dup = join_kernels.build_sorted(
+            rk, build_batch.selection)
+        ctx.add_flag("join_build_dup", dup)
+        match_idx, found = join_kernels.probe(keys_s, perm, n_valid, lk,
+                                              probe_batch.selection)
+        psel = probe_batch.selection_mask()
+
+        if self.how == "left_semi":
+            return probe_batch.with_selection(psel & found)
+        if self.how == "left_anti":
+            null_key = jnp.zeros_like(found)
+            if lk.validity is not None:
+                null_key = ~lk.validity
+            return probe_batch.with_selection(psel & ~found & ~null_key)
+
+        # assemble: probe columns + gathered build columns (renamed per schema)
+        out_names = self._schema.names
+        n_left = len(probe_batch.columns)
+        left_names = list(probe_batch.columns.keys())
+        cols: Dict[str, Column] = {}
+        for name, out_name in zip(left_names, out_names[:n_left]):
+            cols[out_name] = probe_batch.columns[name]
+        name_map = list(zip(build_batch.columns.keys(), out_names[n_left:]))
+        for out_name, col in join_kernels.gather_build_columns(
+                build_batch, match_idx, found, name_map):
+            cols[out_name] = col
+
+        if self.how == "inner":
+            sel = psel & found
+        else:  # left
+            sel = psel
+        out = Batch(cols, sel)
+        if self.condition is not None:
+            v = self.condition.eval(out)
+            keep = v.data if v.validity is None else (v.data & v.validity)
+            if self.how == "inner":
+                out = out.with_selection(sel & keep)
+            else:
+                raise AnalysisError(
+                    "residual join condition only supported for inner joins")
+        return out
+
+    def simple_string(self):
+        return (f"JoinExec({self.how}, {[repr(k) for k in self.left_keys]} = "
+                f"{[repr(k) for k in self.right_keys]}, "
+                f"cond={self.condition!r})")
+
+
+def _pack_keys(vecs: List[Vec]) -> Vec:
+    """Pack multiple integer join keys into one int64 (collision-free when
+    widths fit; dictionary codes use |dict| width)."""
+    acc = None
+    validity = None
+    for v in vecs:
+        if not isinstance(v.dtype, (T.IntegralType, T.StringType, T.DateType,
+                                    T.BooleanType)):
+            raise AnalysisError(f"multi-key join on {v.dtype!r} unsupported")
+        width = 32
+        data = v.data.astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
+        acc = data if acc is None else (acc << width) | data
+        if v.validity is not None:
+            validity = v.validity if validity is None else (validity & v.validity)
+    return Vec(acc, T.LONG, validity)
+
+
+class ExchangeExec(UnaryExec):
+    """Repartitioning boundary (reference: ShuffleExchangeExec.scala:115).
+    On a single chip this is a logical no-op; on a mesh it lowers to
+    radix-partition + all_to_all (execution/shuffle.py)."""
+
+    def __init__(self, child: PhysicalPlan, partitioning: Partitioning):
+        self.children = (child,)
+        self.partitioning = partitioning
+
+    def schema(self):
+        return self.child.schema()
+
+    def output_partitioning(self):
+        return self.partitioning
+
+    def compute(self, ctx, inputs):
+        return inputs[0]
+
+    def simple_string(self):
+        return f"ExchangeExec({self.partitioning!r})"
+
+
+class UnionExec(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 out_schema: T.Schema):
+        self.children = (left, right)
+        self._schema = out_schema
+
+    def schema(self):
+        return self._schema
+
+    def compute(self, ctx, inputs):
+        lb, rb = inputs
+        cols = {}
+        for out_f, ln, rn in zip(self._schema.fields, lb.names, rb.names):
+            lc, rc = lb.columns[ln], rb.columns[rn]
+            data = jnp.concatenate([
+                lc.data.astype(out_f.dtype.np_dtype),
+                rc.data.astype(out_f.dtype.np_dtype)])
+            if lc.validity is None and rc.validity is None:
+                validity = None
+            else:
+                lv = lc.validity if lc.validity is not None else \
+                    jnp.ones((lb.capacity,), jnp.bool_)
+                rv = rc.validity if rc.validity is not None else \
+                    jnp.ones((rb.capacity,), jnp.bool_)
+                validity = jnp.concatenate([lv, rv])
+            cols[out_f.name] = Column(data, out_f.dtype, validity, lc.dictionary)
+        sel = jnp.concatenate([lb.selection_mask(), rb.selection_mask()])
+        return Batch(cols, sel)

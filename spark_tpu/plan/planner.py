@@ -1,0 +1,87 @@
+"""Logical -> physical planning.
+
+The analog of the reference's `SparkPlanner.scala:28` strategies +
+`EnsureRequirements.scala:44`: translate each logical node into an
+executable operator, then walk the tree inserting Exchange nodes wherever
+a child's output partitioning does not satisfy the operator's required
+distribution. On one chip everything is SinglePartition and no exchange
+materializes; the distributed planner (parallel/) re-plans aggregates as
+partial/final across a hash exchange the way `AggUtils.scala` does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import Conf
+from ..expr import AnalysisError
+from . import logical as L
+from . import physical as P
+
+
+def plan_physical(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
+    phys = _convert(plan, conf)
+    phys = ensure_requirements(phys, conf)
+    return phys
+
+
+def _convert(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
+    if isinstance(plan, L.Range):
+        return P.RangeExec(plan.start, plan.end, plan.step)
+    if isinstance(plan, L.Scan):
+        return P.ScanExec(plan.source, plan.required_columns, plan.pushed_filters)
+    if isinstance(plan, L.Project):
+        return P.ProjectExec(_convert(plan.child, conf), plan.exprs)
+    if isinstance(plan, L.Filter):
+        return P.FilterExec(_convert(plan.child, conf), plan.condition)
+    if isinstance(plan, L.Aggregate):
+        return P.HashAggregateExec(_convert(plan.child, conf),
+                                   plan.group_exprs, plan.agg_exprs,
+                                   mode="complete")
+    if isinstance(plan, L.Join):
+        if plan.how == "right":
+            raise AnalysisError(
+                "right join: rewrite as left join with swapped inputs")
+        return P.JoinExec(_convert(plan.left, conf), _convert(plan.right, conf),
+                          plan.left_keys, plan.right_keys, plan.how,
+                          plan.condition, plan.schema())
+    if isinstance(plan, L.Sort):
+        return P.SortExec(_convert(plan.child, conf), plan.orders)
+    if isinstance(plan, L.Limit):
+        return P.LimitExec(_convert(plan.child, conf), plan.n)
+    if isinstance(plan, L.Union):
+        return P.UnionExec(_convert(plan.children[0], conf),
+                           _convert(plan.children[1], conf), plan.schema())
+    raise AnalysisError(f"no physical strategy for {type(plan).__name__}")
+
+
+def ensure_requirements(plan: P.PhysicalPlan, conf: Conf) -> P.PhysicalPlan:
+    """Insert exchanges where child partitioning fails the requirement
+    (reference: EnsureRequirements.ensureDistributionAndOrdering:49)."""
+    new_children = tuple(ensure_requirements(c, conf) for c in plan.children)
+    if new_children != plan.children:
+        import copy
+        plan = copy.copy(plan)
+        plan.children = new_children
+    fixed = []
+    changed = False
+    for child, dist in zip(plan.children, plan.required_child_distributions()):
+        if child.output_partitioning().satisfies(dist):
+            fixed.append(child)
+            continue
+        changed = True
+        if isinstance(dist, P.ClusteredDistribution):
+            n = int(conf.get("spark_tpu.sql.shuffle.partitions"))
+            fixed.append(P.ExchangeExec(
+                child, P.HashPartitioning(dist.keys, n)))
+        elif isinstance(dist, P.AllTuples):
+            fixed.append(P.ExchangeExec(child, P.SinglePartition()))
+        elif isinstance(dist, P.BroadcastDistribution):
+            fixed.append(P.ExchangeExec(child, P.Replicated()))
+        else:
+            fixed.append(child)
+    if changed:
+        import copy
+        plan = copy.copy(plan)
+        plan.children = tuple(fixed)
+    return plan

@@ -1,0 +1,68 @@
+"""Rule executor: fixed-point batches of plan-rewrite rules.
+
+Direct analog of the reference's `catalyst/rules/RuleExecutor.scala`
+(fixed-point vs once batches, per-rule effectiveness tracking a la
+`QueryPlanningTracker.scala:93`).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Sequence
+
+from .logical import LogicalPlan
+
+
+class Rule:
+    name: str = "rule"
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        raise NotImplementedError
+
+
+@dataclass
+class Batch:
+    name: str
+    rules: Sequence[Rule]
+    strategy: str = "fixed_point"  # or "once"
+    max_iterations: int = 100
+
+
+@dataclass
+class RuleTiming:
+    total_ns: int = 0
+    invocations: int = 0
+    effective: int = 0
+
+
+class RuleExecutor:
+    def __init__(self, batches: Sequence[Batch]):
+        self.batches = list(batches)
+        self.timings: Dict[str, RuleTiming] = {}
+
+    def execute(self, plan: LogicalPlan) -> LogicalPlan:
+        for batch in self.batches:
+            iters = 1 if batch.strategy == "once" else batch.max_iterations
+            for _ in range(iters):
+                changed = False
+                for rule in batch.rules:
+                    t0 = time.perf_counter_ns()
+                    new_plan = rule.apply(plan)
+                    t = self.timings.setdefault(rule.name, RuleTiming())
+                    t.total_ns += time.perf_counter_ns() - t0
+                    t.invocations += 1
+                    if new_plan is not plan and not new_plan.same_result(plan):
+                        t.effective += 1
+                        changed = True
+                        plan = new_plan
+                    else:
+                        plan = new_plan
+                if not changed:
+                    break
+            else:
+                if batch.strategy == "fixed_point":
+                    raise RuntimeError(
+                        f"batch {batch.name!r} did not converge in "
+                        f"{batch.max_iterations} iterations")
+        return plan

@@ -1,0 +1,100 @@
+"""SparkSession analog: catalog + conf + entry points.
+
+Reference: `sql/core/src/main/scala/org/apache/spark/sql/SparkSession.scala:83`
+(builder, per-session conf/catalog/state) and `DataFrameReader`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import pandas as pd
+import pyarrow as pa
+
+from .columnar import Batch
+from .config import Conf
+from .dataframe import DataFrame
+from .io.sources import ArrowTableSource, ParquetSource, TableSource
+from .plan import logical as L
+
+
+class SparkTpuSession:
+    _active: Optional["SparkTpuSession"] = None
+
+    def __init__(self, conf: Optional[Conf] = None):
+        self.conf = conf or Conf()
+        self.catalog: Dict[str, TableSource] = {}
+        self._stage_cache: Dict[str, object] = {}
+        SparkTpuSession._active = self
+
+    # -- builder ------------------------------------------------------------
+
+    class Builder:
+        def __init__(self):
+            self._conf = Conf()
+
+        def config(self, key: str, value) -> "SparkTpuSession.Builder":
+            self._conf.set(key, value)
+            return self
+
+        def get_or_create(self) -> "SparkTpuSession":
+            if SparkTpuSession._active is not None:
+                return SparkTpuSession._active
+            return SparkTpuSession(self._conf)
+
+        getOrCreate = get_or_create
+
+    @classmethod
+    def builder(cls) -> "SparkTpuSession.Builder":
+        return cls.Builder()
+
+    # -- entry points -------------------------------------------------------
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.Range(int(start), int(end), int(step)))
+
+    def create_dataframe(self, data, name: str = "df") -> DataFrame:
+        if isinstance(data, pd.DataFrame):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        else:
+            raise TypeError(f"cannot create DataFrame from {type(data)}")
+        source = ArrowTableSource(name, table)
+        return DataFrame(self, L.Scan(source))
+
+    createDataFrame = create_dataframe
+
+    def register_table(self, name: str, source_or_table) -> None:
+        if isinstance(source_or_table, TableSource):
+            self.catalog[name] = source_or_table
+        elif isinstance(source_or_table, pa.Table):
+            self.catalog[name] = ArrowTableSource(name, source_or_table)
+        elif isinstance(source_or_table, pd.DataFrame):
+            self.catalog[name] = ArrowTableSource(
+                name, pa.Table.from_pandas(source_or_table,
+                                           preserve_index=False))
+        elif isinstance(source_or_table, DataFrame):
+            self.catalog[name] = ArrowTableSource(
+                name, source_or_table.collect())
+        else:
+            raise TypeError(f"cannot register {type(source_or_table)}")
+
+    def table(self, name: str) -> DataFrame:
+        if name not in self.catalog:
+            raise KeyError(f"table {name!r} not found; "
+                           f"known: {sorted(self.catalog)}")
+        return DataFrame(self, L.Scan(self.catalog[name]))
+
+    def read_parquet(self, path: str, name: Optional[str] = None) -> DataFrame:
+        return DataFrame(self, L.Scan(ParquetSource(path, name)))
+
+    def sql(self, query: str) -> DataFrame:
+        from .sql.parser import parse_sql
+        plan = parse_sql(query, self)
+        return DataFrame(self, plan)

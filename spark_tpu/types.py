@@ -1,0 +1,229 @@
+"""Data types for the TPU-native engine.
+
+Mirrors the surface of the reference's `sql/catalyst/.../types/*` (e.g.
+`DataType`, `StructType`) but the *device representation* is designed for
+TPU, not for UnsafeRow (`sql/catalyst/src/main/java/.../UnsafeRow.java:62`):
+
+- every column is a flat ``jax.Array`` plus an optional validity mask;
+- strings are dictionary-encoded: device data is int32 codes into a
+  host-side pyarrow dictionary (SURVEY.md section 2.4 row "Off-heap memory
+  + pointer strings");
+- DECIMAL(p, s) is a scaled int64 on device: exact integer arithmetic is
+  fast on the VPU and gives bit-exact SUM/GROUP BY parity, unlike float
+  accumulation. Division and AVG promote to float64.
+- DATE is days-since-epoch int32; TIMESTAMP is microseconds int64.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class DataType:
+    """Base of the type lattice (reference: catalyst types/DataType.scala)."""
+
+    #: numpy dtype of the device representation
+    np_dtype: np.dtype = None  # type: ignore
+
+    def simple_string(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return self.simple_string()
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self).__name__)
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class ByteType(IntegralType):
+    np_dtype = np.dtype(np.int8)
+
+
+class ShortType(IntegralType):
+    np_dtype = np.dtype(np.int16)
+
+
+class IntegerType(IntegralType):
+    np_dtype = np.dtype(np.int32)
+
+
+class LongType(IntegralType):
+    np_dtype = np.dtype(np.int64)
+
+
+class FloatType(FractionalType):
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(FractionalType):
+    np_dtype = np.dtype(np.float64)
+
+
+class StringType(DataType):
+    """Dictionary-encoded: device repr is int32 codes (-1 reserved unused);
+    bytes live in a host-side pyarrow dictionary on the column."""
+
+    np_dtype = np.dtype(np.int32)
+
+
+class DateType(DataType):
+    """Days since 1970-01-01, int32 (same physical encoding as Arrow date32)."""
+
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch, int64."""
+
+    np_dtype = np.dtype(np.int64)
+
+
+@dataclass(frozen=True)
+class DecimalType(FractionalType):
+    """DECIMAL(precision, scale) as scaled int64 on device.
+
+    value = unscaled / 10**scale. Addition/subtraction are exact; a
+    multiply of (p1,s1)x(p2,s2) yields scale s1+s2 (rescaled by the
+    expression layer); division promotes to float64. Precision is tracked
+    for schema fidelity but int64 range (~9.2e18) is the true bound —
+    overflow behavior follows ANSI_ENABLED like the reference's
+    `Decimal.scala`.
+    """
+
+    precision: int = 38
+    scale: int = 18
+
+    np_dtype = np.dtype(np.int64)
+
+    def simple_string(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, DecimalType)
+                and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __hash__(self) -> int:
+        return hash(("decimal", self.precision, self.scale))
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)
+
+
+# Singletons, mirroring the reference's `DataTypes` statics.
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+
+@dataclass(frozen=True)
+class Field:
+    """A named, typed, nullable column (reference: StructField)."""
+
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+    def __repr__(self) -> str:
+        return f"{self.name}:{self.dtype!r}{'' if self.nullable else ' not null'}"
+
+
+@dataclass(frozen=True)
+class Schema:
+    """Ordered column list (reference: StructType)."""
+
+    fields: Tuple[Field, ...]
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(repr(f) for f in self.fields) + ")"
+
+
+def is_integer_like(dt: DataType) -> bool:
+    return isinstance(dt, IntegralType) or isinstance(dt, (StringType, DateType, BooleanType))
+
+
+_WIDENING: List[type] = [ByteType, ShortType, IntegerType, LongType,
+                         FloatType, DoubleType]
+
+
+def common_type(a: DataType, b: DataType) -> DataType:
+    """Least common numeric type, mirroring the reference's TypeCoercion
+    (`sql/catalyst/.../analysis/TypeCoercion.scala`) for the numeric lattice."""
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType(min(38, intd + scale), scale)
+    if isinstance(a, DecimalType):
+        if isinstance(b, IntegralType):
+            return a
+        if isinstance(b, FractionalType):
+            return DOUBLE
+    if isinstance(b, DecimalType):
+        return common_type(b, a)
+    if isinstance(a, NumericType) and isinstance(b, NumericType):
+        ia = _WIDENING.index(type(a))
+        ib = _WIDENING.index(type(b))
+        return _WIDENING[max(ia, ib)]()
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    raise TypeError(f"no common type for {a!r} and {b!r}")

@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax loads.
+
+The analog of the reference's `local-cluster[N,...]` multi-process test
+mechanism (SURVEY.md section 4): sharding/collective code paths run on
+8 virtual CPU devices so multi-chip logic is exercised in CI without TPU
+hardware. Must run before any jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session():
+    from spark_tpu import SparkTpuSession
+    return SparkTpuSession.builder().get_or_create()

@@ -1,0 +1,179 @@
+"""End-to-end slice tests: the minimum viable query paths
+(SURVEY.md section 7 step 4: range -> group-by -> count)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col, lit
+
+
+def test_range_collect(session):
+    df = session.range(10)
+    out = df.collect()
+    assert out.column("id").to_pylist() == list(range(10))
+
+
+def test_range_groupby_count(session):
+    # BASELINE config 1 in miniature
+    df = session.range(1000).group_by((col("id") % 10).alias("k")).count()
+    pdf = df.to_pandas().sort_values("k").reset_index(drop=True)
+    assert list(pdf["k"]) == list(range(10))
+    assert all(pdf["count"] == 100)
+
+
+def test_filter_project(session):
+    df = (session.range(100)
+          .filter(col("id") >= 90)
+          .select((col("id") * 2).alias("x")))
+    out = df.collect().column("x").to_pylist()
+    assert out == [2 * i for i in range(90, 100)]
+
+
+def test_global_aggregate(session):
+    df = session.range(101).agg(
+        F.sum(col("id")).alias("s"),
+        F.count().alias("c"),
+        F.min(col("id")).alias("mn"),
+        F.max(col("id")).alias("mx"),
+        F.avg(col("id")).alias("a"))
+    row = df.to_pandas().iloc[0]
+    assert row["s"] == 5050
+    assert row["c"] == 101
+    assert row["mn"] == 0
+    assert row["mx"] == 100
+    assert abs(row["a"] - 50.0) < 1e-9
+
+
+def test_groupby_sum_multi_key_sort_path(session):
+    pdf = pd.DataFrame({
+        "a": np.array([1, 1, 2, 2, 2, 3], dtype=np.int64) * 1_000_000_007,
+        "b": np.array([0, 0, 0, 1, 1, 1], dtype=np.int64),
+        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+    })
+    df = session.create_dataframe(pdf)
+    out = (df.group_by(col("a"), col("b"))
+           .agg(F.sum(col("v")).alias("s"), F.count().alias("c"))
+           .to_pandas().sort_values(["a", "b"]).reset_index(drop=True))
+    expected = (pdf.groupby(["a", "b"], as_index=False)
+                .agg(s=("v", "sum"), c=("v", "count"))
+                .sort_values(["a", "b"]).reset_index(drop=True))
+    assert len(out) == len(expected)
+    assert np.allclose(out["s"], expected["s"])
+    assert list(out["c"]) == list(expected["c"])
+
+
+def test_join_inner(session):
+    left = session.create_dataframe(pd.DataFrame({
+        "k": np.array([1, 2, 3, 4, 5], dtype=np.int64),
+        "lv": np.array([10, 20, 30, 40, 50], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "k": np.array([2, 4, 6], dtype=np.int64),
+        "rv": np.array([200, 400, 600], dtype=np.int64)}))
+    out = (left.join(right, on="k")
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out["k"]) == [2, 4]
+    assert list(out["lv"]) == [20, 40]
+    assert list(out["rv"]) == [200, 400]
+
+
+def test_join_left(session):
+    left = session.create_dataframe(pd.DataFrame({
+        "k": np.array([1, 2, 3], dtype=np.int64),
+        "lv": np.array([10, 20, 30], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "k": np.array([2], dtype=np.int64),
+        "rv": np.array([200], dtype=np.int64)}))
+    out = (left.join(right, on="k", how="left")
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out["k"]) == [1, 2, 3]
+    assert out["rv"].isna().tolist() == [True, False, True]
+    assert out.loc[1, "rv"] == 200
+
+
+def test_join_semi_anti(session):
+    left = session.create_dataframe(pd.DataFrame({
+        "k": np.array([1, 2, 3, 4], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "k": np.array([2, 4], dtype=np.int64)}))
+    semi = left.join(right, on="k", how="left_semi").to_pandas()
+    anti = left.join(right, on="k", how="left_anti").to_pandas()
+    assert sorted(semi["k"]) == [2, 4]
+    assert sorted(anti["k"]) == [1, 3]
+
+
+def test_join_dup_build_keys_raises(session):
+    left = session.create_dataframe(pd.DataFrame({
+        "k": np.array([1, 2], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "k": np.array([2, 2], dtype=np.int64),
+        "v": np.array([1, 2], dtype=np.int64)}))
+    with pytest.raises(RuntimeError, match="duplicate"):
+        left.join(right, on="k").collect()
+
+
+def test_sort_limit(session):
+    df = session.range(100).sort(col("id").desc()).limit(3)
+    assert df.collect().column("id").to_pylist() == [99, 98, 97]
+
+
+def test_sort_multi_key_with_strings(session):
+    pdf = pd.DataFrame({
+        "s": ["banana", "apple", "cherry", "apple"],
+        "v": np.array([1, 2, 3, 4], dtype=np.int64)})
+    df = session.create_dataframe(pdf)
+    out = df.sort(col("s").asc(), col("v").desc()).to_pandas()
+    assert list(out["s"]) == ["apple", "apple", "banana", "cherry"]
+    assert list(out["v"]) == [4, 2, 1, 3]
+
+
+def test_string_filter_and_groupby(session):
+    pdf = pd.DataFrame({
+        "s": ["x", "y", "x", "z", "y", "x"],
+        "v": np.array([1, 2, 3, 4, 5, 6], dtype=np.int64)})
+    df = session.create_dataframe(pdf)
+    out = (df.filter(col("s") != lit("z"))
+           .group_by(col("s")).agg(F.sum(col("v")).alias("s_v"))
+           .to_pandas().sort_values("s").reset_index(drop=True))
+    assert list(out["s"]) == ["x", "y"]
+    assert list(out["s_v"]) == [10, 7]
+
+
+def test_nulls_propagate(session):
+    pdf = pd.DataFrame({
+        "a": pd.array([1, None, 3, None], dtype="Int64"),
+        "b": np.array([10.0, 20.0, 30.0, 40.0])})
+    df = session.create_dataframe(pdf)
+    out = df.agg(F.sum(col("a")).alias("s"), F.count(col("a")).alias("c"),
+                 F.count().alias("star")).to_pandas().iloc[0]
+    assert out["s"] == 4
+    assert out["c"] == 2
+    assert out["star"] == 4
+    # filter on nullable: NULL comparisons drop rows
+    flt = df.filter(col("a") > 0).to_pandas()
+    assert sorted(flt["b"]) == [10.0, 30.0]
+
+
+def test_union(session):
+    a = session.range(3)
+    b = session.range(3)
+    assert a.union(b).count() == 6
+
+
+def test_decimal_sum_exact(session):
+    import pyarrow as pa
+    import decimal
+    vals = [decimal.Decimal("123456.78"), decimal.Decimal("0.01"),
+            decimal.Decimal("99999999.99")]
+    table = pa.table({"d": pa.array(vals, type=pa.decimal128(18, 2))})
+    df = session.create_dataframe(table)
+    out = df.agg(F.sum(col("d")).alias("s")).collect()
+    assert out.column("s")[0].as_py() == decimal.Decimal("100123456.78")
+
+
+def test_case_when(session):
+    df = session.range(6).select(
+        F.when(col("id") < 2, lit(0)).when(col("id") < 4, lit(1))
+        .otherwise(lit(2)).alias("bucket"))
+    assert df.collect().column("bucket").to_pylist() == [0, 0, 1, 1, 2, 2]
