@@ -1,0 +1,62 @@
+"""Headline benchmark, run by the driver on real TPU hardware.
+
+Config 1 from BASELINE.json: ``range(1e9).groupBy(id % 100).count()`` —
+the same fused range->hash-aggregate loop as the reference's
+`AggregateBenchmark-results.txt` "w/ keys" rows. The committed reference
+number for single-key hash aggregation with whole-stage codegen is
+1812.5 M rows/s (no grouping; `AggregateBenchmark-results.txt:9-11`,
+Xeon Platinum 8171M) — vs_baseline is our rows/s over that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+N = 1_000_000_000
+SPARK_BASELINE_ROWS_PER_SEC = 1812.5e6  # AggregateBenchmark codegen ON
+
+
+def main():
+    from spark_tpu import SparkTpuSession
+    from spark_tpu.functions import col
+
+    spark = SparkTpuSession.builder().get_or_create()
+    df = spark.range(N).group_by((col("id") % 100).alias("k")).count()
+    qe = df._qe()
+
+    import numpy as np
+
+    def run_sync():
+        b, _, _ = qe.execute_batch()
+        # a host pull is the only reliable sync point on tunneled runtimes
+        # where block_until_ready returns before execution completes
+        np.asarray(b.columns["count"].data)
+        return b
+
+    # warmup: compile + first run
+    batch = run_sync()
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        batch = run_sync()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    # correctness gate: every group must count N/100
+    pdf = batch.to_arrow().to_pydict()
+    assert sorted(pdf["k"]) == list(range(100)), pdf["k"][:5]
+    assert all(c == N // 100 for c in pdf["count"]), pdf["count"][:5]
+
+    rows_per_sec = N / best
+    print(json.dumps({
+        "metric": "hash_aggregate_range_1e9_groupby_100",
+        "value": round(rows_per_sec / 1e6, 1),
+        "unit": "M rows/s",
+        "vs_baseline": round(rows_per_sec / SPARK_BASELINE_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,13 +118,17 @@ class Batch:
         return Batch(cols, sel)
 
     @staticmethod
-    def from_arrow(table: pa.Table, growth: float = 2.0) -> "Batch":
+    def from_arrow(table: pa.Table, growth: float = 2.0,
+                   capacity: Optional[int] = None) -> "Batch":
         """Ingest a pyarrow table: dictionary-encode strings, pad to bucket.
 
         Replaces the reference's vectorized Parquet column readers
-        (`VectorizedParquetRecordReader.java:54`) as the host->HBM edge."""
+        (`VectorizedParquetRecordReader.java:54`) as the host->HBM edge.
+        `capacity` forces a fixed padded size (chunked loads keep one
+        compiled shape across chunks)."""
         n = table.num_rows
-        cap = bucket_capacity(n, growth)
+        cap = capacity if capacity is not None else bucket_capacity(n, growth)
+        assert cap >= n, (cap, n)
         cols: Dict[str, Column] = {}
         for name, col in zip(table.column_names, table.columns):
             cols[name] = _arrow_to_column(name, col, n, cap)

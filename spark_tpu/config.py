@@ -126,6 +126,12 @@ BATCH_BUCKET_GROWTH = register(
         "powers of this factor to bound XLA recompilation across batch "
         "sizes (static-shape discipline, SURVEY.md section 7).")
 
+STREAMING_CHUNK_ROWS = register(
+    "spark_tpu.sql.execution.streamingChunkRows", 1 << 26,
+    doc="Chunk size (rows) for streaming large scans through aggregates "
+        "with carried accumulator tables; bounds HBM residency of a scan "
+        "the way the reference's row-iterator pipeline does.")
+
 ADAPTIVE_ENABLED = register(
     "spark_tpu.sql.adaptive.enabled", True,
     doc="Enable adaptive re-planning between stages from runtime row "

@@ -32,12 +32,14 @@ import numpy as np
 
 from .. import types as T
 from ..columnar import Batch, Column, bucket_capacity
-from ..expr import Expression, Literal, Mod, Vec
+from ..expr import Alias, Expression, Literal, Mod, Vec
 from ..expr_agg import AccSpec, AggExpr
 
 
 def key_domain(expr: Expression, vec: Vec) -> Optional[int]:
     """Statically-known integer key domain, or None (trace-time decision)."""
+    while isinstance(expr, Alias):
+        expr = expr.child
     if vec.dictionary is not None:
         return len(vec.dictionary)
     if isinstance(vec.dtype, T.BooleanType):
@@ -67,10 +69,9 @@ _SEGMENT_REDUCE = {
 }
 
 
-def direct_aggregate(key_vecs: Sequence[Vec], domains: Sequence[int],
-                     contribs: List[List], specs: List[List[AccSpec]],
-                     sel) -> Tuple[List, List, object]:
-    """Dense-domain aggregation. Returns (key_arrays, acc_arrays, occupied)."""
+def direct_index(key_vecs: Sequence[Vec], domains: Sequence[int], sel):
+    """Combined dense-domain index per row; unselected rows get an
+    out-of-bounds index (scatter mode='drop' discards them)."""
     total = 1
     strides = []
     for d in domains:
@@ -79,35 +80,111 @@ def direct_aggregate(key_vecs: Sequence[Vec], domains: Sequence[int],
     idx = jnp.zeros((), jnp.int32)
     for vec, d, s in zip(key_vecs, domains, strides):
         idx = idx + _key_index(vec, d) * s
-    # drop unselected rows via out-of-bounds index
     if sel is not None:
         idx = jnp.where(sel, idx, total)
-    occupied_cnt = jnp.zeros((total,), jnp.int32).at[idx].add(
-        jnp.ones_like(idx), mode="drop")
-    accs = []
-    for row_contribs, row_specs in zip(contribs, specs):
-        fn_accs = []
-        for contrib, spec in zip(row_contribs, row_specs):
-            init = jnp.full((total,), spec.neutral)
+    return idx, total, strides
+
+
+def direct_init(domains: Sequence[int], specs: List[List[AccSpec]]):
+    """Fresh accumulator tables: (occupied_cnt, [[acc,...],...])."""
+    total = int(np.prod([d for d in domains] or [1]))
+    cnt = jnp.zeros((total,), jnp.int64)
+    accs = [[jnp.full((total,), spec.neutral) for spec in row]
+            for row in specs]
+    return cnt, accs
+
+
+def direct_update(tables, idx, total, contribs: List[List],
+                  specs: List[List[AccSpec]], kernel_mode: str = "auto"):
+    """Merge one chunk's contributions into carried tables (associative).
+
+    kernel_mode: 'auto' uses the Pallas MXU one-hot matmul kernel on TPU
+    (XLA scatter-add with colliding indices is ~100x slower there) and
+    plain scatter elsewhere; 'matmul'/'scatter' force a path ('matmul'
+    off-TPU runs the kernel in interpret mode, for tests).
+    """
+    cnt, accs = tables
+    if np.ndim(idx) == 0:
+        idx = jnp.broadcast_to(idx, contribs[0][0].shape if contribs
+                               and contribs[0] else (1,))
+
+    all_sum = all(spec.reduce == "sum" for row in specs for spec in row)
+    backend = jax.default_backend()
+    use_kernel = (kernel_mode == "matmul"
+                  or (kernel_mode == "auto" and backend == "tpu"))
+    if all_sum and use_kernel and total <= (1 << 20) and idx.shape[0] >= 128:
+        from .pallas_groupby import dense_groupby_sums
+        int_rows = [jnp.ones(idx.shape, jnp.int64)]
+        float_rows = []
+        layout = []  # (row_kind, index) per (i, j)
+        for contrib_row, spec_row in zip(contribs, specs):
+            for contrib, spec in zip(contrib_row, spec_row):
+                if np.issubdtype(spec.np_dtype, np.floating):
+                    layout.append(("f", len(float_rows)))
+                    float_rows.append(contrib)
+                else:
+                    layout.append(("i", len(int_rows)))
+                    int_rows.append(contrib.astype(jnp.int64))
+        int_sums, float_sums = dense_groupby_sums(
+            idx, int_rows, float_rows, total,
+            interpret=(backend != "tpu"))
+        cnt = cnt + int_sums[0]
+        new_accs = []
+        k = 0
+        for table_row, spec_row in zip(accs, specs):
+            new_row = []
+            for table, spec in zip(table_row, spec_row):
+                kind, pos = layout[k]
+                k += 1
+                if kind == "f":
+                    new_row.append(table + float_sums[pos].astype(spec.np_dtype))
+                else:
+                    new_row.append(table + int_sums[pos].astype(spec.np_dtype))
+            new_accs.append(new_row)
+        return cnt, new_accs
+
+    cnt = cnt.at[idx].add(jnp.ones(idx.shape, jnp.int64), mode="drop")
+    new_accs = []
+    for table_row, contrib_row, spec_row in zip(accs, contribs, specs):
+        new_row = []
+        for table, contrib, spec in zip(table_row, contrib_row, spec_row):
             if spec.reduce == "sum":
-                out = jnp.zeros((total,), spec.np_dtype).at[idx].add(
-                    contrib, mode="drop")
+                new_row.append(table.at[idx].add(contrib, mode="drop"))
             elif spec.reduce == "min":
-                out = init.at[idx].min(contrib, mode="drop")
+                new_row.append(table.at[idx].min(contrib, mode="drop"))
             else:
-                out = init.at[idx].max(contrib, mode="drop")
-            fn_accs.append(out)
-        accs.append(fn_accs)
-    # reconstruct key values from the dense index
+                new_row.append(table.at[idx].max(contrib, mode="drop"))
+        new_accs.append(new_row)
+    return cnt, new_accs
+
+
+def direct_keys(domains: Sequence[int], strides: Sequence[int],
+                key_dtypes: Sequence[T.DataType]) -> List:
+    """Reconstruct key column values from the dense domain index."""
+    total = int(np.prod([d for d in domains] or [1]))
     out_idx = jnp.arange(total, dtype=jnp.int32)
     key_arrays = []
     rem = out_idx
-    for d, s, vec in zip(reversed(domains), reversed(strides), reversed(key_vecs)):
+    for d, s, dt in zip(reversed(domains), reversed(strides),
+                        reversed(list(key_dtypes))):
         k = rem // s
         rem = rem - k * s
-        key_arrays.append(k.astype(vec.dtype.np_dtype))
+        key_arrays.append(k.astype(dt.np_dtype))
     key_arrays.reverse()
-    return key_arrays, accs, occupied_cnt > 0
+    return key_arrays
+
+
+def direct_aggregate(key_vecs: Sequence[Vec], domains: Sequence[int],
+                     contribs: List[List], specs: List[List[AccSpec]],
+                     sel) -> Tuple[List, List, object]:
+    """One-shot dense-domain aggregation.
+    Returns (key_arrays, acc_arrays, occupied)."""
+    idx, total, strides = direct_index(key_vecs, domains, sel)
+    tables = direct_init(domains, specs)
+    cnt, accs = direct_update(tables, idx, total, contribs, specs)
+    key_arrays = direct_keys(domains, strides,
+                             [v.dtype for v in key_vecs])
+    return key_arrays, accs, cnt > 0
 
 
 def sort_aggregate(key_vecs: Sequence[Vec],

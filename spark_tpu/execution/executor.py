@@ -73,16 +73,33 @@ class QueryExecution:
     # -- execution ----------------------------------------------------------
 
     def _collect_scans(self, node: P.PhysicalPlan,
-                       out: List[P.ScanExec]) -> None:
-        if isinstance(node, P.ScanExec):
+                       out: List[P.LeafExec]) -> None:
+        if getattr(node, "needs_input", False):
             out.append(node)
         for c in node.children:
             self._collect_scans(c, out)
 
+    def _materialize_streaming(self, node: P.PhysicalPlan) -> P.PhysicalPlan:
+        """Execute streamable aggregates eagerly (chunked, accumulator
+        carry) and splice their results back as InputExec leaves."""
+        from .streaming_agg import try_stream_aggregate
+        if isinstance(node, P.HashAggregateExec):
+            result = try_stream_aggregate(node, self.session.conf,
+                                          self.session._stage_cache)
+            if result is not None:
+                return P.InputExec(result, node.schema(), label="streamed_agg")
+        new_children = tuple(self._materialize_streaming(c)
+                             for c in node.children)
+        if new_children != node.children:
+            import copy
+            node = copy.copy(node)
+            node.children = new_children
+        return node
+
     def execute_batch(self) -> Tuple[Batch, Dict, Dict]:
         """Run the query, returning (device Batch, flags, metrics)."""
-        root = self.executed_plan
-        scans: List[P.ScanExec] = []
+        root = self._materialize_streaming(self.executed_plan)
+        scans: List[P.LeafExec] = []
         self._collect_scans(root, scans)
 
         t0 = time.perf_counter()
@@ -98,7 +115,7 @@ class QueryExecution:
                 counter = [0]
 
                 def replay(node: P.PhysicalPlan) -> Batch:
-                    if isinstance(node, P.ScanExec):
+                    if getattr(node, "needs_input", False):
                         b = inputs[counter[0]]
                         counter[0] += 1
                         return b

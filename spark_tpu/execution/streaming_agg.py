@@ -1,0 +1,187 @@
+"""Streaming (chunked) aggregation driver.
+
+The reference streams rows through operator iterators so working sets
+never materialize (`WholeStageCodegenExec`'s produce/consume loop,
+`TungstenAggregationIterator.scala:82`); a naive XLA translation instead
+materializes the whole scan in HBM and dies on inputs larger than device
+memory. This driver restores the streaming discipline at batch
+granularity: a jitted `update(tables, chunk) -> tables` step is compiled
+once and driven over input chunks (device-synthesized range chunks, or
+host-ingested scan chunks), with accumulator tables donated across steps.
+Narrow ops (project/filter) replay inside the update step, so XLA still
+fuses scan->filter->aggregate into one kernel per chunk.
+
+Streaming applies when the aggregate takes the dense-domain direct path
+(statically-bounded group count). The sort-based general path falls back
+to whole-input execution.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..columnar import Batch, Column, bucket_capacity
+from ..plan import physical as P
+from . import aggregate as agg_kernels
+
+CHUNK_ROWS_KEY = "spark_tpu.sql.execution.streamingChunkRows"
+
+
+def find_streamable_chain(agg: "P.HashAggregateExec"
+                          ) -> Optional[Tuple[List, P.LeafExec]]:
+    """agg.child must be a chain of Project/Filter over a single leaf."""
+    chain = []
+    node = agg.child
+    while isinstance(node, (P.ProjectExec, P.FilterExec)):
+        chain.append(node)
+        node = node.children[0]
+    if isinstance(node, (P.RangeExec, P.ScanExec)):
+        return chain, node
+    return None
+
+
+def _replay_chain(chain: List, ctx, batch: Batch) -> Batch:
+    for op in reversed(chain):
+        batch = op.compute(ctx, [batch])
+    return batch
+
+
+def _range_chunk(leaf: P.RangeExec, start, chunk_rows: int,
+                 rows_total: int) -> Batch:
+    """Synthesize one chunk of a Range in-trace; `start` is a traced row
+    offset so one compiled step serves every chunk."""
+    ids = leaf.start + leaf.step * (start + jnp.arange(chunk_rows,
+                                                      dtype=jnp.int64))
+    sel = (start + jnp.arange(chunk_rows, dtype=jnp.int64)) < rows_total
+    return Batch({"id": Column(ids, T.LONG)}, sel)
+
+
+def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,
+                           leaf: P.RangeExec, conf,
+                           cache: Optional[dict] = None) -> Optional[Batch]:
+    """Run agg over a big Range in chunks. Returns the result batch, or
+    None when the direct path doesn't apply. `cache` (the session stage
+    cache) persists the compiled update step across executions — the
+    analog of the reference's Janino codegen cache."""
+    chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
+    rows_total = leaf.num_rows()
+
+    key = f"stream_range:{agg.describe()}:{chunk_rows}:{rows_total}"
+    run = cache.get(key) if cache is not None else None
+    if run is None:
+        ctx = P.ExecContext(conf)
+        probe = _replay_chain(chain, ctx,
+                              _range_chunk(leaf, jnp.int64(0), 8, rows_total))
+        prep = agg.prepare_direct(probe, conf)
+        if prep is None:
+            return None
+        n_chunks = -(-rows_total // chunk_rows)
+
+        # the source is device-synthesized, so the whole chunk loop fuses
+        # into ONE dispatch (a lax.fori_loop with carried tables) — no
+        # host round-trip per chunk
+        @jax.jit
+        def run():
+            def body(i, tables):
+                ctx = P.ExecContext(conf)
+                b = _replay_chain(
+                    chain, ctx,
+                    _range_chunk(leaf, i.astype(jnp.int64) * chunk_rows,
+                                 chunk_rows, rows_total))
+                return agg.direct_update_tables(tables, b, prep)
+
+            tables = jax.lax.fori_loop(0, n_chunks, body,
+                                       agg.direct_init_tables(prep))
+            return agg.direct_finalize_tables(tables, prep)
+
+        if cache is not None:
+            cache[key] = run
+    return run()
+
+
+def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
+                          leaf: P.ScanExec, conf,
+                          cache: Optional[dict] = None) -> Optional[Batch]:
+    """Run agg over a chunked Scan: host ingests record-batch chunks
+    (uniform bucketed capacity so the update step compiles once) while the
+    device reduces — the double-buffered host->HBM pipeline of SURVEY.md
+    section 2.5 'Async/overlap'."""
+    chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
+    chunks = leaf.source.load_chunks(leaf.required_columns,
+                                     leaf.pushed_filters, chunk_rows)
+    first = next(iter(chunks), None)
+    if first is None:
+        return None
+    key = f"stream_scan:{agg.describe()}:{chunk_rows}"
+    bundle = cache.get(key) if cache is not None else None
+    if bundle is None:
+        ctx = P.ExecContext(conf)
+        probe = _replay_chain(chain, ctx, first)
+        prep = agg.prepare_direct(probe, conf)
+        if prep is None:
+            return None
+
+        def update(tables, b):
+            ctx = P.ExecContext(conf)
+            b = _replay_chain(chain, ctx, b)
+            return agg.direct_update_tables(tables, b, prep)
+
+        bundle = (prep, jax.jit(update, donate_argnums=(0,)))
+        if cache is not None:
+            cache[key] = bundle
+    prep, update_donated = bundle
+
+    # guard: a chunk whose dictionary outgrows the padded domain would
+    # silently alias groups; fail loudly instead
+    dict_limits = {}
+    for g, dom, dic in zip(agg.group_exprs, prep.domains, prep.key_dicts):
+        if dic is not None and len(g.references()) == 1:
+            dict_limits[next(iter(g.references()))] = dom
+
+    def check_dicts(b: Batch):
+        for name, limit in dict_limits.items():
+            col = b.columns.get(name)
+            if col is not None and col.dictionary is not None \
+                    and len(col.dictionary) > limit:
+                raise RuntimeError(
+                    f"dictionary of {name!r} grew past the padded direct "
+                    f"domain ({len(col.dictionary)} > {limit}); raise "
+                    f"spark_tpu.sql.aggregate.maxDirectDomain or disable "
+                    f"streaming")
+
+    tables = agg.direct_init_tables(prep)
+    check_dicts(first)
+    tables = update_donated(tables, first)
+    for b in chunks:
+        check_dicts(b)
+        tables = update_donated(tables, b)
+
+    dict_overrides = dict(chunks.dictionaries) if hasattr(
+        chunks, "dictionaries") else {}
+    return agg.direct_finalize_tables(tables, prep, dict_overrides or None)
+
+
+def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
+                         cache: Optional[dict] = None) -> Optional[Batch]:
+    if agg.mode != "complete":
+        return None
+    found = find_streamable_chain(agg)
+    if found is None:
+        return None
+    chain, leaf = found
+    chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
+    if isinstance(leaf, P.RangeExec):
+        if leaf.num_rows() <= chunk_rows:
+            return None
+        return stream_range_aggregate(agg, chain, leaf, conf, cache)
+    est = leaf.source.estimated_rows()
+    if est is not None and est <= chunk_rows:
+        return None
+    if not hasattr(leaf.source, "load_chunks"):
+        return None
+    return stream_scan_aggregate(agg, chain, leaf, conf, cache)

@@ -10,12 +10,12 @@ single-chip segment-reduce, the partial/final split across a shuffle, and
 `psum`-tree merges across the mesh — replacing Spark's partial/final
 physical planning in `AggUtils.scala`.
 
-Decimal SUM uses two-limb int64 accumulation (hi/lo split at 2**32):
-exact for >=2^62-magnitude running sums where a single int64 would
-overflow (e.g. TPC-H SF100 sum_charge), recombined in arbitrary-precision
-Python at finalize. This replaces the reference's Decimal.scala + unsafe
-row-based `UnsafeFixedWidthAggregationMap.java:39` with a formulation the
-VPU executes at full rate.
+Decimal/integer SUM accumulates in int64 mod 2^64 (integer adds wrap):
+intermediate wraparound is harmless because modular arithmetic recovers
+the true sum whenever the final value fits int64 — which is the bound of
+the scaled-decimal representation itself. This replaces the reference's
+Decimal.scala + `UnsafeFixedWidthAggregationMap.java:39` with plain
+vector adds (and the MXU limb kernel in execution/pallas_groupby.py).
 """
 
 from __future__ import annotations
@@ -33,11 +33,16 @@ from .expr import Expression, Vec, cast_vec, _and_valid
 
 @dataclass(frozen=True)
 class AccSpec:
-    """One accumulator column: reduce kind + device dtype + neutral value."""
+    """One accumulator column: reduce kind + device dtype + neutral value.
+
+    `width` bounds the per-row contribution: width=8 promises values in
+    [0, 256), letting the MXU group-by kernel carry the row as a single
+    bf16 limb instead of eight (counts are the common case)."""
 
     suffix: str
     np_dtype: np.dtype
     reduce: str  # 'sum' | 'min' | 'max'
+    width: int = 64
 
     @property
     def neutral(self):
@@ -115,7 +120,7 @@ class Count(AggregateFunction):
         return False
 
     def accumulators(self, schema):
-        return [AccSpec("count", np.dtype(np.int64), "sum")]
+        return [AccSpec("count", np.dtype(np.int64), "sum", width=8)]
 
     def update(self, batch, sel):
         if self.child is None:
@@ -147,29 +152,18 @@ class Sum(AggregateFunction):
 
     def accumulators(self, schema):
         dt = self.child.dtype(schema)
-        if isinstance(dt, T.DecimalType):
-            return [AccSpec("sum_hi", np.dtype(np.int64), "sum"),
-                    AccSpec("sum_lo", np.dtype(np.int64), "sum"),
-                    AccSpec("cnt", np.dtype(np.int64), "sum")]
-        if isinstance(dt, T.IntegralType):
+        # int64 sums accumulate mod 2^64 (adds wrap): the final value is
+        # exact whenever the true sum fits int64, which is the bound of
+        # our scaled-decimal representation anyway — no multi-limb
+        # accumulator needed (the MXU kernel limb-decomposes internally)
+        if isinstance(dt, (T.DecimalType, T.IntegralType)):
             return [AccSpec("sum", np.dtype(np.int64), "sum"),
-                    AccSpec("cnt", np.dtype(np.int64), "sum")]
+                    AccSpec("cnt", np.dtype(np.int64), "sum", width=8)]
         return [AccSpec("sum", np.dtype(np.float64), "sum"),
-                AccSpec("cnt", np.dtype(np.int64), "sum")]
+                AccSpec("cnt", np.dtype(np.int64), "sum", width=8)]
 
     def update(self, batch, sel):
         v, m = self._eval_child(batch, sel)
-        dt = v.dtype
-        if isinstance(dt, T.DecimalType):
-            x = v.data.astype(jnp.int64)
-            hi = x >> 32           # arithmetic shift: exact two-limb split
-            lo = x & jnp.int64(0xFFFFFFFF)
-            z = jnp.zeros_like(x)
-            one = jnp.ones_like(x)
-            if m is None:
-                return [hi, lo, one]
-            return [jnp.where(m, hi, z), jnp.where(m, lo, z),
-                    jnp.where(m, one, z)]
         spec = self.accumulators(batch.schema())[0]
         x = v.data.astype(spec.np_dtype)
         cnt = jnp.ones((batch.capacity,), jnp.int64)
@@ -179,21 +173,10 @@ class Sum(AggregateFunction):
                 jnp.where(m, cnt, jnp.zeros_like(cnt))]
 
     def finalize(self, accs, schema):
-        dt = self.child.dtype(schema)
-        if isinstance(dt, T.DecimalType):
-            hi, lo, cnt = accs
-            total = [int(h) * (1 << 32) + int(l) for h, l in zip(hi, lo)]
-            return np.array(total, dtype=np.int64), cnt > 0
         total, cnt = accs
         return total, cnt > 0
 
     def device_finalize(self, accs, schema):
-        dt = self.child.dtype(schema)
-        if isinstance(dt, T.DecimalType):
-            hi, lo, cnt = accs
-            # any decimal representable in our scaled-int64 fits here; an
-            # overflowing recombine is a genuine DECIMAL overflow
-            return (hi << 32) + lo, cnt > 0
         total, cnt = accs
         return total, cnt > 0
 
@@ -215,21 +198,21 @@ class Avg(AggregateFunction):
     def finalize(self, accs, schema):
         dt = self.child.dtype(schema)
         if isinstance(dt, T.DecimalType):
-            hi, lo, cnt = accs
+            total, cnt = accs
             out_dt = self.result_type(schema)
             extra = 10 ** (out_dt.scale - dt.scale)
             vals = []
-            for h, l, c in zip(hi, lo, cnt):
+            for tot, c in zip(total, cnt):
                 if c == 0:
                     vals.append(0)
-                else:
-                    tot = (int(h) * (1 << 32) + int(l)) * extra
-                    q, r = divmod(tot, int(c)) if tot >= 0 else \
-                        (-((-tot) // int(c)), -((-tot) % int(c)))
-                    # HALF_UP
-                    if 2 * abs(r) >= c:
-                        q += 1 if tot >= 0 else -1
-                    vals.append(q)
+                    continue
+                tot = int(tot) * extra
+                q, r = divmod(tot, int(c)) if tot >= 0 else \
+                    (-((-tot) // int(c)), -((-tot) % int(c)))
+                # HALF_UP
+                if 2 * abs(r) >= c:
+                    q += 1 if tot >= 0 else -1
+                vals.append(q)
             return np.array(vals, dtype=np.int64), cnt > 0
         total, cnt = accs
         safe = np.where(cnt > 0, cnt, 1)
@@ -238,12 +221,12 @@ class Avg(AggregateFunction):
     def device_finalize(self, accs, schema):
         dt = self.child.dtype(schema)
         if isinstance(dt, T.DecimalType):
-            hi, lo, cnt = accs
-            tot = ((hi << 32) + lo).astype(jnp.float64)
+            total, cnt = accs
             out_dt = self.result_type(schema)
             extra = 10.0 ** (out_dt.scale - dt.scale)
             safe = jnp.where(cnt > 0, cnt, 1)
-            return jnp.round(tot * extra / safe).astype(jnp.int64), cnt > 0
+            return jnp.round(total.astype(jnp.float64) * extra / safe) \
+                .astype(jnp.int64), cnt > 0
         total, cnt = accs
         safe = jnp.where(cnt > 0, cnt, 1)
         return (total / safe).astype(jnp.float64), cnt > 0
@@ -258,7 +241,7 @@ class _MinMax(AggregateFunction):
     def accumulators(self, schema):
         dt = self.child.dtype(schema)
         return [AccSpec(self._reduce, dt.np_dtype, self._reduce),
-                AccSpec("cnt", np.dtype(np.int64), "sum")]
+                AccSpec("cnt", np.dtype(np.int64), "sum", width=8)]
 
     def update(self, batch, sel):
         v, m = self._eval_child(batch, sel)

@@ -102,6 +102,87 @@ def _arrow_schema_to_engine(schema: pa.Schema) -> T.Schema:
     return T.Schema(fields)
 
 
+class DictUnifier:
+    """Grows one global dictionary per string column across chunks so
+    device codes are comparable between chunks (append-only: codes handed
+    out earlier stay valid). The analog of the reference's per-column
+    dictionary pages being resolved to one dictionary at read time."""
+
+    def __init__(self):
+        self.dicts = {}
+
+    def unify(self, table: pa.Table) -> pa.Table:
+        cols = []
+        for name, col in zip(table.column_names, table.columns):
+            arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+            at = arr.type
+            if pa.types.is_string(at) or pa.types.is_large_string(at):
+                arr = arr.cast(pa.string()).dictionary_encode()
+                at = arr.type
+            if pa.types.is_dictionary(at):
+                chunk_dict = arr.dictionary.cast(pa.string())
+                glob = self.dicts.get(name)
+                if glob is None:
+                    glob = chunk_dict
+                else:
+                    present = pc.index_in(chunk_dict, value_set=glob)
+                    new_mask = pc.is_null(present)
+                    if pc.any(new_mask).as_py():
+                        new_vals = pc.filter(chunk_dict, new_mask)
+                        glob = pa.concat_arrays([glob, new_vals])
+                self.dicts[name] = glob
+                mapping = pc.index_in(chunk_dict, value_set=glob) \
+                    .cast(pa.int32())
+                codes = mapping.take(arr.indices)
+                arr = pa.DictionaryArray.from_arrays(codes, glob)
+            cols.append(arr)
+        return pa.table(cols, names=table.column_names)
+
+
+class ChunkIterator:
+    """Single-pass iterator of uniform-capacity Batches over a record
+    -batch stream; `.dictionaries` holds the final global dictionaries."""
+
+    def __init__(self, batches_iter, chunk_rows: int):
+        self._batches = batches_iter
+        self._chunk_rows = chunk_rows
+        self._capacity = None
+        self._pending = []
+        self._pending_rows = 0
+        self._done = False
+        self._unifier = DictUnifier()
+
+    @property
+    def dictionaries(self):
+        return self._unifier.dicts
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Batch:
+        while not self._done and self._pending_rows < self._chunk_rows:
+            try:
+                rb = next(self._batches)
+            except StopIteration:
+                self._done = True
+                break
+            self._pending.append(rb)
+            self._pending_rows += rb.num_rows
+        if self._pending_rows == 0:
+            raise StopIteration
+        table = pa.Table.from_batches(self._pending)
+        take = min(self._pending_rows, self._chunk_rows)
+        chunk = table.slice(0, take)
+        rest = table.slice(take)
+        self._pending = rest.to_batches() if rest.num_rows else []
+        self._pending_rows = rest.num_rows
+        if self._capacity is None:
+            from ..columnar import bucket_capacity
+            self._capacity = bucket_capacity(self._chunk_rows)
+        chunk = self._unifier.unify(chunk)
+        return Batch.from_arrow(chunk, capacity=self._capacity)
+
+
 class ArrowTableSource(TableSource):
     """In-memory table (the reference's LocalRelation / InMemoryRelation)."""
 
@@ -127,6 +208,17 @@ class ArrowTableSource(TableSource):
         if required_columns is not None:
             t = t.select(list(required_columns))
         return Batch.from_arrow(t)
+
+    def load_chunks(self, required_columns, pushed_filters,
+                    chunk_rows: int) -> ChunkIterator:
+        t = self.table
+        for f in pushed_filters:
+            ae = expr_to_arrow(f)
+            if ae is not None:
+                t = t.filter(ae)
+        if required_columns is not None:
+            t = t.select(list(required_columns))
+        return ChunkIterator(iter(t.to_batches()), chunk_rows)
 
 
 class ParquetSource(TableSource):
@@ -161,3 +253,15 @@ class ParquetSource(TableSource):
             columns=list(required_columns) if required_columns is not None else None,
             filter=ae)
         return Batch.from_arrow(t)
+
+    def load_chunks(self, required_columns, pushed_filters,
+                    chunk_rows: int) -> ChunkIterator:
+        ae = None
+        for f in pushed_filters:
+            e = expr_to_arrow(f)
+            if e is not None:
+                ae = e if ae is None else (ae & e)
+        scanner = self._dataset.scanner(
+            columns=list(required_columns) if required_columns is not None else None,
+            filter=ae, batch_size=min(chunk_rows, 1 << 20))
+        return ChunkIterator(scanner.to_batches(), chunk_rows)

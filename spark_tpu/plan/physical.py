@@ -225,6 +225,32 @@ class ScanExec(LeafExec):
                 f"pushed={[repr(f) for f in self.pushed_filters]})")
 
 
+class InputExec(LeafExec):
+    """A leaf holding an already-computed device Batch (e.g. the result of
+    a streamed aggregation) — the analog of a materialized QueryStageExec
+    in the reference's AQE loop (`AdaptiveSparkPlanExec.scala:64`)."""
+
+    needs_input = True
+
+    def __init__(self, batch: Batch, schema: T.Schema, label: str = "input"):
+        self._batch = batch
+        self._schema = schema
+        self.label = label
+        self.children = ()
+
+    def schema(self):
+        return self._schema
+
+    def load(self) -> Batch:
+        return self._batch
+
+    def compute(self, ctx, inputs):
+        raise RuntimeError("InputExec.compute is handled by the executor")
+
+    def simple_string(self):
+        return f"InputExec({self.label},{self._schema!r})"
+
+
 class UnaryExec(PhysicalPlan):
     @property
     def child(self) -> PhysicalPlan:
@@ -409,6 +435,69 @@ class HashAggregateExec(UnaryExec):
         ctx.add_metric(f"agg_groups", jnp.sum(occupied.astype(jnp.int32)))
         return Batch(cols, occupied)
 
+    # -- reusable direct-path steps (shared with the streaming driver) ------
+
+    def prepare_direct(self, probe_batch: Batch, conf,
+                       pad_dict: bool = True) -> Optional["DirectAggPlan"]:
+        """Trace-time check + static metadata for the dense-domain path.
+        Returns None when any key lacks a static domain (sort path)."""
+        base = self._base_schema()
+        key_vecs = [g.eval(probe_batch) for g in self.group_exprs]
+        domains = []
+        for g, v in zip(self.group_exprs, key_vecs):
+            d = agg_kernels.key_domain(g, v)
+            if d is None or v.validity is not None:
+                return None
+            if pad_dict and v.dictionary is not None:
+                # headroom for dictionaries that grow across chunks
+                d = bucket_capacity(max(16, 2 * d))
+            domains.append(d)
+        total = int(np.prod(domains or [1]))
+        if total > int(conf.get("spark_tpu.sql.aggregate.maxDirectDomain")):
+            return None
+        strides = []
+        t = 1
+        for d in domains:
+            strides.append(t)
+            t *= d
+        specs = [a.func.accumulators(base) for a in self.agg_exprs]
+        return DirectAggPlan(
+            domains=domains, strides=strides, total=total,
+            key_dtypes=[v.dtype for v in key_vecs],
+            key_dicts=[v.dictionary for v in key_vecs], specs=specs)
+
+    def direct_init_tables(self, prep: "DirectAggPlan"):
+        return agg_kernels.direct_init(prep.domains, prep.specs)
+
+    def direct_update_tables(self, tables, batch: Batch,
+                             prep: "DirectAggPlan", conf=None):
+        sel = batch.selection
+        key_vecs = [g.eval(batch) for g in self.group_exprs]
+        idx, _, _ = agg_kernels.direct_index(key_vecs, prep.domains, sel)
+        contribs = [a.func.update(batch, sel) for a in self.agg_exprs]
+        return agg_kernels.direct_update(tables, idx, prep.total, contribs,
+                                         prep.specs)
+
+    def direct_finalize_tables(self, tables, prep: "DirectAggPlan",
+                               dict_overrides: Optional[Dict] = None) -> Batch:
+        cnt, accs = tables
+        base = self._base_schema()
+        occupied = cnt > 0
+        key_arrays = agg_kernels.direct_keys(prep.domains, prep.strides,
+                                             prep.key_dtypes)
+        if not self.group_exprs:
+            occupied = jnp.ones((1,), jnp.bool_)
+        cols: Dict[str, Column] = {}
+        for g, arr, dt, dic in zip(self.group_exprs, key_arrays,
+                                   prep.key_dtypes, prep.key_dicts):
+            if dict_overrides and g.name() in dict_overrides:
+                dic = dict_overrides[g.name()]
+            cols[g.name()] = Column(arr, dt, None, dic)
+        for i, a in enumerate(self.agg_exprs):
+            data, validity = a.func.device_finalize(accs[i], base)
+            cols[a.out_name] = Column(data, a.func.result_type(base), validity)
+        return Batch(cols, occupied)
+
     def output_partitioning(self):
         if not self.group_exprs:
             return SinglePartition()
@@ -426,6 +515,18 @@ class HashAggregateExec(UnaryExec):
         return (f"HashAggregateExec(mode={self.mode}, "
                 f"groups={[repr(g) for g in self.group_exprs]}, "
                 f"aggs={[repr(a) for a in self.agg_exprs]})")
+
+
+@dataclass
+class DirectAggPlan:
+    """Static (trace-time) metadata for the dense-domain aggregate path."""
+
+    domains: List[int]
+    strides: List[int]
+    total: int
+    key_dtypes: List[T.DataType]
+    key_dicts: List
+    specs: List
 
 
 def _np_to_logical(np_dtype) -> T.DataType:

@@ -8,10 +8,16 @@ hardware. Must run before any jax import.
 
 import os
 
+# JAX_PLATFORMS alone is overridden by the axon TPU plugin in this image;
+# the config update below is what actually pins the backend to CPU.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

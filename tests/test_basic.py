@@ -177,3 +177,20 @@ def test_case_when(session):
         F.when(col("id") < 2, lit(0)).when(col("id") < 4, lit(1))
         .otherwise(lit(2)).alias("bucket"))
     assert df.collect().column("bucket").to_pylist() == [0, 0, 1, 1, 2, 2]
+
+
+def test_mod_strength_reduction_exact(session):
+    # the TPU mod fast path must match Python % semantics exactly,
+    # including negatives and values near the int64 boundary
+    import pyarrow as pa
+    vals = [0, 1, 99, 100, 101, -1, -100, -101, 2**31 - 1, -2**31,
+            2**52, 2**52 + 12345, 2**62, -2**62, 2**63 - 1, -2**63,
+            987654321987654321, -987654321987654321]
+    for m in (1, 2, 7, 100, 1 << 20, (1 << 26) - 1):
+        df = session.create_dataframe(
+            pa.table({"x": pa.array(vals, type=pa.int64())}))
+        from spark_tpu.functions import col, lit
+        out = df.select((col("x") % lit(m)).alias("r")).collect()
+        got = out.column("r").to_pylist()
+        expect = [v % m for v in vals]
+        assert got == expect, (m, got, expect)
