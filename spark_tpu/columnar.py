@@ -223,6 +223,84 @@ jax.tree_util.register_pytree_node(Batch, _batch_flatten, _batch_unflatten)
 
 
 # ---------------------------------------------------------------------------
+# Dictionary algebra (host-side, trace-time static)
+#
+# String columns carry host pyarrow dictionaries; device data is int32
+# codes. Any transform that slices or combines dictionaries must keep the
+# invariant "equal strings <=> equal codes *within one dictionary*", and
+# any operator combining two columns must first remap both onto one shared
+# dictionary. These helpers do that once on host; the resulting remap
+# tables become jit constants (a gather on device).
+# ---------------------------------------------------------------------------
+
+
+def dedupe_dictionary(dictionary: pa.Array):
+    """Collapse duplicate values in a dictionary.
+
+    Returns (remap, deduped) where `remap` is a device int32 table mapping
+    old code -> new code, or None when the dictionary was already unique.
+    Needed after value transforms (e.g. substring) that can map distinct
+    old values onto one new value — otherwise group-by/join on codes would
+    treat equal strings as distinct (the reference gets this for free from
+    UTF8String equality)."""
+    import pyarrow.compute as pc
+    arr = dictionary.combine_chunks() if isinstance(
+        dictionary, pa.ChunkedArray) else dictionary
+    uniq = pc.unique(arr)
+    if len(uniq) == len(arr):
+        return None, arr
+    remap = pc.index_in(arr, value_set=uniq).cast(pa.int32())
+    return jnp.asarray(remap.to_numpy(zero_copy_only=False)), uniq
+
+
+def unify_dictionaries(da: pa.Array, db: pa.Array):
+    """Merge two (internally unique) dictionaries into one shared one.
+
+    Returns (remap_b, merged): `merged` extends `da` with values of `db`
+    not already present (so codes into `da` stay valid), and `remap_b` is
+    a device int32 table mapping b-codes -> merged codes (None when the
+    dictionaries are identical). Mirrors the chunk-level DictUnifier in
+    io/sources.py, but for two already-loaded columns."""
+    import pyarrow.compute as pc
+    da = da.combine_chunks() if isinstance(da, pa.ChunkedArray) else da
+    db = db.combine_chunks() if isinstance(db, pa.ChunkedArray) else db
+    if da.equals(db):
+        return None, da
+    present = pc.index_in(db, value_set=da)
+    new_mask = pc.is_null(present)
+    if pc.any(new_mask).as_py():
+        new_vals = pc.filter(db, new_mask)
+        merged = pa.concat_arrays([da.cast(pa.string()),
+                                   new_vals.cast(pa.string())])
+    else:
+        merged = da
+    remap = pc.index_in(db, value_set=merged).cast(pa.int32())
+    return jnp.asarray(remap.to_numpy(zero_copy_only=False)), merged
+
+
+def apply_code_remap(codes, remap):
+    """Gather new codes through a remap table (identity when remap is None)."""
+    if remap is None:
+        return codes
+    return jnp.take(remap, jnp.clip(codes, 0, remap.shape[0] - 1))
+
+
+def unify_string_columns(l_data, l_dict: pa.Array, r_data, r_dict: pa.Array):
+    """Re-encode two string code columns onto one shared dictionary.
+
+    Dedupes each side, merges right values into the left dictionary, and
+    remaps both code arrays. Returns (l_data, r_data, merged). After this,
+    code equality <=> string equality across the two columns."""
+    lmap, ld = dedupe_dictionary(l_dict)
+    rmap, rd = dedupe_dictionary(r_dict)
+    l_data = apply_code_remap(l_data, lmap)
+    r_data = apply_code_remap(r_data, rmap)
+    bmap, merged = unify_dictionaries(ld, rd)
+    r_data = apply_code_remap(r_data, bmap)
+    return l_data, r_data, merged
+
+
+# ---------------------------------------------------------------------------
 # Arrow conversion helpers
 # ---------------------------------------------------------------------------
 

@@ -70,6 +70,7 @@ class DataFrame:
              condition: Optional[Expression] = None) -> "DataFrame":
         if how == "right":
             raise AnalysisError("right join: call other.join(self, how='left')")
+        names = None
         if on is not None:
             names = [on] if isinstance(on, str) else list(on)
             lk = [ColumnRef(n) for n in names]
@@ -79,7 +80,15 @@ class DataFrame:
                                      else [left_on])]
             rk = [_expr(e) for e in (right_on if isinstance(right_on, (list, tuple))
                                      else [right_on])]
-        return self._with(L.Join(self.plan, other.plan, lk, rk, how, condition))
+        join = L.Join(self.plan, other.plan, lk, rk, how, condition)
+        if names is not None and how not in ("left_semi", "left_anti"):
+            # USING-join semantics (reference Dataset.join(df, usingColumns)):
+            # the right side's copy of each key column is dropped
+            name_map = join.right_name_map()
+            drop = {name_map[n] for n in names if n in name_map}
+            keep = [n for n in join.schema().names if n not in drop]
+            return self._with(L.Project(join, [ColumnRef(n) for n in keep]))
+        return self._with(join)
 
     def sort(self, *orders) -> "DataFrame":
         os = []

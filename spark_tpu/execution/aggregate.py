@@ -32,33 +32,40 @@ import numpy as np
 
 from .. import types as T
 from ..columnar import Batch, Column, bucket_capacity
-from ..expr import Alias, Expression, Literal, Mod, Vec
+from ..expr import Alias, Expression, Literal, Mod, Pmod, Vec
 from ..expr_agg import AccSpec, AggExpr
 
 
-def key_domain(expr: Expression, vec: Vec) -> Optional[int]:
-    """Statically-known integer key domain, or None (trace-time decision)."""
+def key_domain(expr: Expression, vec: Vec) -> Optional[Tuple[int, int]]:
+    """Statically-known integer key range as (domain, lo) with
+    value in [lo, lo+domain), or None (trace-time decision).
+
+    `lo` matters for signed ranges: truncated `%` yields (-m, m) and BYTE
+    is [-128, 128) — a [0, domain) assumption would silently merge
+    negative keys into slot 0."""
     while isinstance(expr, Alias):
         expr = expr.child
     if vec.dictionary is not None:
-        return len(vec.dictionary)
+        return len(vec.dictionary), 0
     if isinstance(vec.dtype, T.BooleanType):
-        return 2
+        return 2, 0
     if isinstance(vec.dtype, T.ByteType):
-        return 256
+        return 256, -128
     if isinstance(expr, Mod):
         div = expr.children[1]
         while hasattr(div, "child") and div.children:
             div = div.children[0]
         if isinstance(div, Literal) and isinstance(div.value, int) and div.value > 0:
-            return int(div.value)
+            m = int(div.value)
+            if isinstance(expr, Pmod):
+                return m, 0
+            # truncated %: result in (-m, m)
+            return 2 * m - 1, -(m - 1)
     return None
 
 
-def _key_index(vec: Vec, domain: int):
-    idx = vec.data.astype(jnp.int32)
-    if isinstance(vec.dtype, T.BooleanType):
-        idx = vec.data.astype(jnp.int32)
+def _key_index(vec: Vec, domain: int, lo: int):
+    idx = vec.data.astype(jnp.int32) - jnp.int32(lo)
     return jnp.clip(idx, 0, domain - 1)
 
 
@@ -69,25 +76,27 @@ _SEGMENT_REDUCE = {
 }
 
 
-def direct_index(key_vecs: Sequence[Vec], domains: Sequence[int], sel):
+def direct_index(key_vecs: Sequence[Vec], domains: Sequence[Tuple[int, int]],
+                 sel):
     """Combined dense-domain index per row; unselected rows get an
-    out-of-bounds index (scatter mode='drop' discards them)."""
+    out-of-bounds index (scatter mode='drop' discards them).
+    `domains` entries are (domain, lo) pairs from `key_domain`."""
     total = 1
     strides = []
-    for d in domains:
+    for d, _lo in domains:
         strides.append(total)
         total *= d
     idx = jnp.zeros((), jnp.int32)
-    for vec, d, s in zip(key_vecs, domains, strides):
-        idx = idx + _key_index(vec, d) * s
+    for vec, (d, lo), s in zip(key_vecs, domains, strides):
+        idx = idx + _key_index(vec, d, lo) * s
     if sel is not None:
         idx = jnp.where(sel, idx, total)
     return idx, total, strides
 
 
-def direct_init(domains: Sequence[int], specs: List[List[AccSpec]]):
+def direct_init(domains: Sequence[Tuple[int, int]], specs: List[List[AccSpec]]):
     """Fresh accumulator tables: (occupied_cnt, [[acc,...],...])."""
-    total = int(np.prod([d for d in domains] or [1]))
+    total = int(np.prod([d for d, _lo in domains] or [1]))
     cnt = jnp.zeros((total,), jnp.int64)
     accs = [[jnp.full((total,), spec.neutral) for spec in row]
             for row in specs]
@@ -158,23 +167,24 @@ def direct_update(tables, idx, total, contribs: List[List],
     return cnt, new_accs
 
 
-def direct_keys(domains: Sequence[int], strides: Sequence[int],
+def direct_keys(domains: Sequence[Tuple[int, int]], strides: Sequence[int],
                 key_dtypes: Sequence[T.DataType]) -> List:
     """Reconstruct key column values from the dense domain index."""
-    total = int(np.prod([d for d in domains] or [1]))
+    total = int(np.prod([d for d, _lo in domains] or [1]))
     out_idx = jnp.arange(total, dtype=jnp.int32)
     key_arrays = []
     rem = out_idx
-    for d, s, dt in zip(reversed(domains), reversed(strides),
-                        reversed(list(key_dtypes))):
+    for (d, lo), s, dt in zip(reversed(list(domains)), reversed(strides),
+                              reversed(list(key_dtypes))):
         k = rem // s
         rem = rem - k * s
-        key_arrays.append(k.astype(dt.np_dtype))
+        key_arrays.append((k + jnp.int32(lo)).astype(dt.np_dtype))
     key_arrays.reverse()
     return key_arrays
 
 
-def direct_aggregate(key_vecs: Sequence[Vec], domains: Sequence[int],
+def direct_aggregate(key_vecs: Sequence[Vec],
+                     domains: Sequence[Tuple[int, int]],
                      contribs: List[List], specs: List[List[AccSpec]],
                      sel) -> Tuple[List, List, object]:
     """One-shot dense-domain aggregation.

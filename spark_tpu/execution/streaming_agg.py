@@ -139,7 +139,8 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
     # guard: a chunk whose dictionary outgrows the padded domain would
     # silently alias groups; fail loudly instead
     dict_limits = {}
-    for g, dom, dic in zip(agg.group_exprs, prep.domains, prep.key_dicts):
+    for g, (dom, _lo), dic in zip(agg.group_exprs, prep.domains,
+                                  prep.key_dicts):
         if dic is not None and len(g.references()) == 1:
             dict_limits[next(iter(g.references()))] = dom
 

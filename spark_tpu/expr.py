@@ -487,25 +487,81 @@ class Mul(BinaryArithmetic):
 
 
 class Div(BinaryArithmetic):
+    """`/`: true division. Integer/integer -> double (Spark SQL), and
+    decimal division returns a DECIMAL quotient per the reference's
+    `DecimalPrecision` rule (scale = max(6, s1+p2+1)) — capped at scale 8
+    here because the device representation is scaled int64, not int128
+    (documented deviation; values are HALF_UP-rounded at that scale).
+    Division by zero yields NULL (non-ANSI reference behavior)."""
+
     op = "/"
 
+    def nullable(self, schema):
+        return True
+
     def _result_type(self, lt, rt):
-        # reference: integer `/` is true division returning double (Spark SQL)
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            if isinstance(lt, (T.FloatType, T.DoubleType)) or \
+                    isinstance(rt, (T.FloatType, T.DoubleType)):
+                return T.DOUBLE
+            s1 = lt.scale if isinstance(lt, T.DecimalType) else 0
+            p1 = lt.precision if isinstance(lt, T.DecimalType) else 20
+            s2 = rt.scale if isinstance(rt, T.DecimalType) else 0
+            p2 = rt.precision if isinstance(rt, T.DecimalType) else 20
+            scale = min(max(6, s1 + p2 + 1), 8)
+            prec = min(38, p1 - s1 + s2 + scale)
+            return T.DecimalType(prec, scale)
         return T.DOUBLE
 
+    def eval(self, batch: Batch) -> Vec:
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        out = self._result_type(lv.dtype, rv.dtype)
+        validity = _and_valid(lv.validity, rv.validity)
+        if isinstance(out, T.DecimalType):
+            s1 = lv.dtype.scale if isinstance(lv.dtype, T.DecimalType) else 0
+            s2 = rv.dtype.scale if isinstance(rv.dtype, T.DecimalType) else 0
+            l = lv.data if isinstance(lv.dtype, T.DecimalType) else \
+                cast_vec(lv, T.DecimalType(20, 0)).data
+            r = rv.data if isinstance(rv.dtype, T.DecimalType) else \
+                cast_vec(rv, T.DecimalType(20, 0)).data
+            zero = r == 0
+            safe_r = jnp.where(zero, jnp.ones((), r.dtype), r)
+            # unscaled_out = l / r * 10^(out.scale + s2 - s1), HALF_UP.
+            # f64 mantissa bounds exactness; the decimal repr is int64 so
+            # |result| < 2^63 and TPC-H-scale quotients stay exact enough.
+            q = (l.astype(jnp.float64) * (10.0 ** (out.scale + s2 - s1))
+                 / safe_r.astype(jnp.float64))
+            data = (jnp.sign(q) * jnp.floor(jnp.abs(q) + 0.5)).astype(jnp.int64)
+            extra = ~zero
+        else:
+            l = cast_vec(lv, T.DOUBLE).data
+            r = cast_vec(rv, T.DOUBLE).data
+            zero = r == 0.0
+            data = l / jnp.where(zero, jnp.ones((), r.dtype), r)
+            extra = ~zero
+        validity = _and_valid(validity, extra)
+        if validity is not None and np.ndim(validity) == 0:
+            validity = jnp.broadcast_to(validity, np.shape(data))
+        return Vec(data, out, validity)
+
     def _compute(self, lv, rv, out):
-        l = cast_vec(lv, T.DOUBLE).data
-        r = cast_vec(rv, T.DOUBLE).data
-        return l / r
+        raise AssertionError("Div.eval is overridden")
 
 
 class Mod(BinaryArithmetic):
-    op = "%"
+    """`%` with the reference's truncated-division semantics
+    (`arithmetic.scala` Remainder): the result carries the sign of the
+    DIVIDEND (-7 % 3 == -1). `Pmod` is the positive variant (result in
+    [0, |m|)). Division by zero yields NULL (non-ANSI reference behavior)."""
 
-    def _compute(self, lv, rv, out):
-        # TPU has no integer divide; `%` lowers to a slow emulation
-        # (~0.9ns/elem measured). For a constant positive divisor,
-        # strength-reduce. Python sign semantics (result in [0, m)).
+    op = "%"
+    _positive = False  # Pmod overrides
+
+    def nullable(self, schema):
+        return True  # divisor may be zero
+
+    def _compute_valid(self, lv, rv, out):
         div_expr = self.children[1]
         while isinstance(div_expr, (Alias, Cast)):
             div_expr = div_expr.children[0]
@@ -514,6 +570,9 @@ class Mod(BinaryArithmetic):
                 and 0 < div_expr.value < (1 << 26)
                 and isinstance(lv.dtype, T.IntegralType)
                 and isinstance(out, T.IntegralType)):
+            # TPU has no integer divide; `%` lowers to a slow emulation
+            # (~0.9ns/elem measured). For a constant positive divisor,
+            # strength-reduce via exact f64 reciprocal-multiply.
             m = int(div_expr.value)
             x = lv.data
 
@@ -526,20 +585,59 @@ class Mod(BinaryArithmetic):
 
             if np.dtype(x.dtype).itemsize <= 4:
                 r = f64_mod(x.astype(jnp.int64))
-                return r.astype(out.np_dtype)
-            # int64: u32-half mods (f64-exact) + recombination < m^2 < 2^52
-            xu_lo = (x & jnp.int64(0xFFFFFFFF))
-            xu_hi = ((x >> 32) & jnp.int64(0xFFFFFFFF))
-            pow32_m = (1 << 32) % m
-            pow64_m = (1 << 64) % m
-            combined = f64_mod(xu_hi) * pow32_m + f64_mod(xu_lo)
-            r = f64_mod(combined)
-            # x (signed) = x_u - 2^64*[x<0]; adjust modulo m
-            r = jnp.where(x < 0, r - pow64_m, r)
-            r = jnp.where(r < 0, r + m, r)
-            r = jnp.where(r >= m, r - m, r)
-            return r.astype(out.np_dtype)
-        return _align(lv, out) % _align(rv, out)
+            else:
+                # int64: u32-half mods (f64-exact) + recombination < m^2 < 2^52
+                xu_lo = (x & jnp.int64(0xFFFFFFFF))
+                xu_hi = ((x >> 32) & jnp.int64(0xFFFFFFFF))
+                pow32_m = (1 << 32) % m
+                pow64_m = (1 << 64) % m
+                combined = f64_mod(xu_hi) * pow32_m + f64_mod(xu_lo)
+                r = f64_mod(combined)
+                # x (signed) = x_u - 2^64*[x<0]; adjust modulo m
+                r = jnp.where(x < 0, r - pow64_m, r)
+                r = jnp.where(r < 0, r + m, r)
+                r = jnp.where(r >= m, r - m, r)
+            if not self._positive:
+                # fast path computed pmod; shift to truncated semantics
+                r = jnp.where((x < 0) & (r != 0), r - m, r)
+            return r.astype(out.np_dtype), None
+        l = _align(lv, out)
+        r = _align(rv, out)
+        zero = r == jnp.zeros((), r.dtype)
+        safe_r = jnp.where(zero, jnp.ones((), r.dtype), r)
+        fr = l % safe_r  # floored: sign of divisor
+        if self._positive:
+            res = jnp.where(fr < 0, fr + jnp.abs(safe_r), fr)
+        else:
+            # truncated: sign of dividend
+            sign_mismatch = (l < 0) != (safe_r < jnp.zeros((), safe_r.dtype))
+            res = jnp.where((fr != 0) & sign_mismatch, fr - safe_r, fr)
+        return res, ~zero
+
+    def _compute(self, lv, rv, out):
+        data, _ = self._compute_valid(lv, rv, out)
+        return data
+
+    def eval(self, batch: Batch) -> Vec:
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        out_dtype = self._result_type(lv.dtype, rv.dtype)
+        data, extra_valid = self._compute_valid(lv, rv, out_dtype)
+        validity = _and_valid(_and_valid(lv.validity, rv.validity), extra_valid)
+        if validity is not None and np.ndim(validity) == 0:
+            validity = jnp.broadcast_to(validity, np.shape(data))
+        return Vec(data, out_dtype, validity)
+
+
+class Pmod(Mod):
+    """pmod(a, m): positive modulo, result in [0, |m|) (the reference's
+    `Pmod`, arithmetic.scala). The dense-domain group-by path keys on this."""
+
+    op = "pmod"
+    _positive = True
+
+    def __repr__(self):
+        return f"pmod({self.children[0]!r}, {self.children[1]!r})"
 
 
 class Neg(Expression):
@@ -850,15 +948,20 @@ class Substring(Expression):
         return T.STRING
 
     def eval(self, batch):
+        from .columnar import apply_code_remap, dedupe_dictionary
         v = self.children[0].eval(batch)
         if v.dictionary is None:
             raise AnalysisError("substring requires dictionary-encoded strings")
         new_dict = pc.utf8_slice_codeunits(
             v.dictionary, start=self.start - 1,
             stop=self.start - 1 + self.length)
-        # note: codes may now collide in new_dict; group-by re-encodes
-        return Vec(v.data, T.STRING, v.validity, new_dict.combine_chunks()
-                   if isinstance(new_dict, pa.ChunkedArray) else new_dict)
+        # distinct old values can slice to one new value: dedupe the new
+        # dictionary and remap device codes so equal strings share a code
+        # (group-by/join compare codes directly)
+        remap, uniq = dedupe_dictionary(
+            new_dict.combine_chunks()
+            if isinstance(new_dict, pa.ChunkedArray) else new_dict)
+        return Vec(apply_code_remap(v.data, remap), T.STRING, v.validity, uniq)
 
     def __repr__(self):
         return f"substring({self.children[0]!r},{self.start},{self.length})"

@@ -58,6 +58,12 @@ def year(e) -> ExtractYear:
     return ExtractYear(_expr(e))
 
 
+def pmod(dividend, divisor) -> Expression:
+    """Positive modulo: result in [0, |divisor|) (reference: pmod())."""
+    from .expr import Pmod
+    return Pmod(_expr(dividend), _expr(divisor))
+
+
 class _WhenBuilder(Expression):
     """when(cond, val).when(...).otherwise(...) chain (functions.scala when)."""
 

@@ -227,25 +227,31 @@ class Join(LogicalPlan):
     def right(self):
         return self.children[1]
 
+    def right_name_map(self) -> dict:
+        """right-field name -> output name (collisions suffixed `_r`)."""
+        taken = {f.name for f in self.left.schema().fields}
+        m = {}
+        for f in self.right.schema().fields:
+            name = f.name
+            while name in taken:
+                name = name + "_r"
+            m[f.name] = name
+            taken.add(name)
+        return m
+
     def schema(self) -> T.Schema:
         ls = self.left.schema()
         if self.how in ("left_semi", "left_anti"):
             return ls
         rs = self.right.schema()
-        fields = list(ls.fields)
-        left_names = {f.name for f in fields}
+        name_map = self.right_name_map()
+        left_nullable = self.how in ("right", "full")
+        right_nullable = self.how in ("left", "full")
+        fields = [T.Field(f.name, f.dtype, f.nullable or left_nullable)
+                  for f in ls.fields]
         for f in rs.fields:
-            name = f.name
-            while name in left_names:
-                name = name + "_r"
-            right_nullable = f.nullable or self.how == "left"
-            fields.append(T.Field(name, f.dtype, right_nullable))
-            left_names.add(name)
-        if self.how == "right":
-            fields = [T.Field(f.name, f.dtype,
-                              f.nullable or ls.field(f.name).nullable
-                              if f.name in ls.names else f.nullable)
-                      for f in fields]
+            fields.append(T.Field(name_map[f.name], f.dtype,
+                                  f.nullable or right_nullable))
         return T.Schema(fields)
 
     def simple_string(self):

@@ -398,7 +398,8 @@ class HashAggregateExec(UnaryExec):
         max_domain = int(ctx.conf.get("spark_tpu.sql.aggregate.maxDirectDomain"))
         use_direct = (all(d is not None for d in domains)
                       and all(v.validity is None for v in key_vecs)
-                      and int(np.prod([d for d in domains]or [1])) <= max_domain)
+                      and int(np.prod([d for d, _lo in domains] or [1]))
+                      <= max_domain)
 
         cs = self.child.schema()
         if use_direct:
@@ -445,19 +446,20 @@ class HashAggregateExec(UnaryExec):
         key_vecs = [g.eval(probe_batch) for g in self.group_exprs]
         domains = []
         for g, v in zip(self.group_exprs, key_vecs):
-            d = agg_kernels.key_domain(g, v)
-            if d is None or v.validity is not None:
+            dom = agg_kernels.key_domain(g, v)
+            if dom is None or v.validity is not None:
                 return None
+            d, lo = dom
             if pad_dict and v.dictionary is not None:
                 # headroom for dictionaries that grow across chunks
                 d = bucket_capacity(max(16, 2 * d))
-            domains.append(d)
-        total = int(np.prod(domains or [1]))
+            domains.append((d, lo))
+        total = int(np.prod([d for d, _lo in domains] or [1]))
         if total > int(conf.get("spark_tpu.sql.aggregate.maxDirectDomain")):
             return None
         strides = []
         t = 1
-        for d in domains:
+        for d, _lo in domains:
             strides.append(t)
             t *= d
         specs = [a.func.accumulators(base) for a in self.agg_exprs]
@@ -519,9 +521,10 @@ class HashAggregateExec(UnaryExec):
 
 @dataclass
 class DirectAggPlan:
-    """Static (trace-time) metadata for the dense-domain aggregate path."""
+    """Static (trace-time) metadata for the dense-domain aggregate path.
+    `domains` entries are (domain, lo) pairs — see `aggregate.key_domain`."""
 
-    domains: List[int]
+    domains: List[Tuple[int, int]]
     strides: List[int]
     total: int
     key_dtypes: List[T.DataType]
@@ -617,18 +620,25 @@ class JoinExec(PhysicalPlan):
 
     def compute(self, ctx, inputs):
         probe_batch, build_batch = inputs
-        if len(self.left_keys) != 1:
-            # pack multiple int keys into one 64-bit key
-            lk = _pack_keys([k.eval(probe_batch) for k in self.left_keys])
-            rk = _pack_keys([k.eval(build_batch) for k in self.right_keys])
+        lvecs = [k.eval(probe_batch) for k in self.left_keys]
+        rvecs = [k.eval(build_batch) for k in self.right_keys]
+        lvecs, rvecs = _unify_key_dictionaries(lvecs, rvecs)
+        if len(lvecs) != 1:
+            lk, rk, exact = _pack_key_pair(lvecs, rvecs)
         else:
-            lk = self.left_keys[0].eval(probe_batch)
-            rk = self.right_keys[0].eval(build_batch)
+            lk, rk = lvecs[0], rvecs[0]
+            exact = True
         keys_s, perm, n_valid, valid_s, dup = join_kernels.build_sorted(
             rk, build_batch.selection)
         ctx.add_flag("join_build_dup", dup)
         match_idx, found = join_kernels.probe(keys_s, perm, n_valid, lk,
                                               probe_batch.selection)
+        if not exact:
+            # hashed pack: verify true per-key equality on the matched row
+            for lv, rv in zip(lvecs, rvecs):
+                found = found & (lv.data == jnp.take(rv.data, match_idx))
+                if rv.validity is not None:
+                    found = found & jnp.take(rv.validity, match_idx)
         psel = probe_batch.selection_mask()
 
         if self.how == "left_semi":
@@ -672,21 +682,121 @@ class JoinExec(PhysicalPlan):
                 f"cond={self.condition!r})")
 
 
-def _pack_keys(vecs: List[Vec]) -> Vec:
-    """Pack multiple integer join keys into one int64 (collision-free when
-    widths fit; dictionary codes use |dict| width)."""
-    acc = None
-    validity = None
-    for v in vecs:
-        if not isinstance(v.dtype, (T.IntegralType, T.StringType, T.DateType,
-                                    T.BooleanType)):
-            raise AnalysisError(f"multi-key join on {v.dtype!r} unsupported")
-        width = 32
-        data = v.data.astype(jnp.int64) & jnp.int64(0xFFFFFFFF)
-        acc = data if acc is None else (acc << width) | data
-        if v.validity is not None:
-            validity = v.validity if validity is None else (validity & v.validity)
-    return Vec(acc, T.LONG, validity)
+def _unify_key_dictionaries(lvecs: List[Vec], rvecs: List[Vec]
+                            ) -> Tuple[List[Vec], List[Vec]]:
+    """Re-encode string join keys onto one shared dictionary per key pair.
+
+    Two independently-encoded string columns assign codes independently, so
+    comparing raw codes is meaningless (round-1 high-severity bug). The
+    merge happens on host at trace time; codes are remapped with a device
+    gather. Non-string keys pass through."""
+    from ..columnar import unify_string_columns
+    out_l, out_r = [], []
+    for lv, rv in zip(lvecs, rvecs):
+        if not isinstance(lv.dtype, T.StringType) and \
+                not isinstance(rv.dtype, T.StringType):
+            out_l.append(lv)
+            out_r.append(rv)
+            continue
+        if lv.dictionary is None or rv.dictionary is None:
+            raise AnalysisError(
+                "string join keys require dictionary-encoded columns")
+        l_data, r_data, merged = unify_string_columns(
+            lv.data, lv.dictionary, rv.data, rv.dictionary)
+        out_l.append(Vec(l_data, T.STRING, lv.validity, merged))
+        out_r.append(Vec(r_data, T.STRING, rv.validity, merged))
+    return out_l, out_r
+
+
+def _key_width(v: Vec) -> Optional[int]:
+    """Bits needed to represent the key's domain, or None when unbounded."""
+    if v.dictionary is not None:
+        n = len(v.dictionary)
+        return max(1, (n - 1).bit_length()) if n > 1 else 1
+    if isinstance(v.dtype, T.BooleanType):
+        return 1
+    if isinstance(v.dtype, T.ByteType):
+        return 8
+    if isinstance(v.dtype, T.ShortType):
+        return 16
+    if isinstance(v.dtype, (T.IntegerType, T.DateType)):
+        return 32
+    return None  # int64/timestamp: full range, cannot pack with others
+
+
+def _unsigned_key(v: Vec, width: int):
+    """Map key values to [0, 2^width) preserving distinctness (bias the
+    sign bit for signed dtypes; dictionary codes are already unsigned)."""
+    data = v.data.astype(jnp.int64)
+    if v.dictionary is None and not isinstance(v.dtype, T.BooleanType):
+        data = data + jnp.int64(1 << (width - 1))
+    return data
+
+
+_MIX_MUL = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_MUL2 = np.uint64(0x94D049BB133111EB)
+
+
+def _mix64(x):
+    """splitmix64 finalizer (wrapping uint64 arithmetic)."""
+    u = x.astype(jnp.uint64)
+    u = (u ^ (u >> 30)) * _MIX_MUL
+    u = (u ^ (u >> 27)) * _MIX_MUL2
+    u = u ^ (u >> 31)
+    return u.astype(jnp.int64)
+
+
+def _pack_key_pair(lvecs: List[Vec], rvecs: List[Vec]
+                   ) -> Tuple[Vec, Vec, bool]:
+    """Combine multi-key join keys into one int64 key per side.
+
+    Widths are derived JOINTLY per key position (max of the two sides) so
+    both sides share one bit layout. Returns (lk, rk, exact): when the
+    combined widths fit in 63 bits the packing is collision-free
+    (exact=True); otherwise both sides are hash-mixed and the caller MUST
+    re-verify per-key equality on matches (round-1 packed lossily and
+    joined silently wrong)."""
+    validity_l = None
+    validity_r = None
+    for lv, rv in zip(lvecs, rvecs):
+        for v in (lv, rv):
+            if not isinstance(v.dtype, (T.IntegralType, T.StringType,
+                                        T.DateType, T.BooleanType,
+                                        T.TimestampType)):
+                raise AnalysisError(
+                    f"multi-key join on {v.dtype!r} unsupported")
+        if lv.validity is not None:
+            validity_l = lv.validity if validity_l is None else \
+                (validity_l & lv.validity)
+        if rv.validity is not None:
+            validity_r = rv.validity if validity_r is None else \
+                (validity_r & rv.validity)
+    def kind(v):
+        if v.dictionary is not None:
+            return "dict"
+        return "bool" if isinstance(v.dtype, T.BooleanType) else "int"
+
+    widths = []
+    for lv, rv in zip(lvecs, rvecs):
+        wl, wr = _key_width(lv), _key_width(rv)
+        if wl is None or wr is None or kind(lv) != kind(rv):
+            widths.append(None)  # hash path (+ per-key re-verify)
+        else:
+            widths.append(max(wl, wr))
+    if all(w is not None for w in widths) and sum(widths) <= 63:
+        acc_l = jnp.zeros((), jnp.int64)
+        acc_r = jnp.zeros((), jnp.int64)
+        for lv, rv, w in zip(lvecs, rvecs, widths):
+            acc_l = (acc_l << w) | _unsigned_key(lv, w)
+            acc_r = (acc_r << w) | _unsigned_key(rv, w)
+        return (Vec(acc_l, T.LONG, validity_l),
+                Vec(acc_r, T.LONG, validity_r), True)
+    hl = jnp.zeros((), jnp.int64)
+    hr = jnp.zeros((), jnp.int64)
+    for lv, rv in zip(lvecs, rvecs):
+        hl = _mix64(hl ^ _mix64(lv.data.astype(jnp.int64)))
+        hr = _mix64(hr ^ _mix64(rv.data.astype(jnp.int64)))
+    return Vec(hl, T.LONG, validity_l), Vec(hr, T.LONG, validity_r), False
 
 
 class ExchangeExec(UnaryExec):
@@ -721,13 +831,25 @@ class UnionExec(PhysicalPlan):
         return self._schema
 
     def compute(self, ctx, inputs):
+        from ..columnar import unify_string_columns
         lb, rb = inputs
         cols = {}
         for out_f, ln, rn in zip(self._schema.fields, lb.names, rb.names):
             lc, rc = lb.columns[ln], rb.columns[rn]
+            l_data, r_data = lc.data, rc.data
+            dictionary = None
+            if isinstance(out_f.dtype, T.StringType):
+                # merge the two dictionaries and remap right codes — raw
+                # right codes under the left dictionary decode to wrong
+                # strings (round-1 high-severity bug)
+                if lc.dictionary is None or rc.dictionary is None:
+                    raise AnalysisError(
+                        "UNION of string columns requires dictionaries")
+                l_data, r_data, dictionary = unify_string_columns(
+                    l_data, lc.dictionary, r_data, rc.dictionary)
             data = jnp.concatenate([
-                lc.data.astype(out_f.dtype.np_dtype),
-                rc.data.astype(out_f.dtype.np_dtype)])
+                l_data.astype(out_f.dtype.np_dtype),
+                r_data.astype(out_f.dtype.np_dtype)])
             if lc.validity is None and rc.validity is None:
                 validity = None
             else:
@@ -736,6 +858,6 @@ class UnionExec(PhysicalPlan):
                 rv = rc.validity if rc.validity is not None else \
                     jnp.ones((rb.capacity,), jnp.bool_)
                 validity = jnp.concatenate([lv, rv])
-            cols[out_f.name] = Column(data, out_f.dtype, validity, lc.dictionary)
+            cols[out_f.name] = Column(data, out_f.dtype, validity, dictionary)
         sel = jnp.concatenate([lb.selection_mask(), rb.selection_mask()])
         return Batch(cols, sel)

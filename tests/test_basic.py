@@ -180,17 +180,32 @@ def test_case_when(session):
 
 
 def test_mod_strength_reduction_exact(session):
-    # the TPU mod fast path must match Python % semantics exactly,
-    # including negatives and values near the int64 boundary
+    # the TPU mod fast path must match the reference's truncated-division
+    # `%` (sign of the dividend), including values near the int64 boundary
     import pyarrow as pa
     vals = [0, 1, 99, 100, 101, -1, -100, -101, 2**31 - 1, -2**31,
             2**52, 2**52 + 12345, 2**62, -2**62, 2**63 - 1, -2**63,
             987654321987654321, -987654321987654321]
+
+    def trunc_mod(v, m):
+        r = abs(v) % m
+        return r if v >= 0 else -r
+
     for m in (1, 2, 7, 100, 1 << 20, (1 << 26) - 1):
         df = session.create_dataframe(
             pa.table({"x": pa.array(vals, type=pa.int64())}))
         from spark_tpu.functions import col, lit
         out = df.select((col("x") % lit(m)).alias("r")).collect()
         got = out.column("r").to_pylist()
-        expect = [v % m for v in vals]
+        expect = [trunc_mod(v, m) for v in vals]
         assert got == expect, (m, got, expect)
+
+
+def test_pmod(session):
+    import pyarrow as pa
+    from spark_tpu.functions import pmod
+    vals = [-7, -1, 0, 1, 7, -2**62, 2**62]
+    df = session.create_dataframe(
+        pa.table({"x": pa.array(vals, type=pa.int64())}))
+    out = df.select(pmod(col("x"), lit(3)).alias("r")).collect()
+    assert out.column("r").to_pylist() == [v % 3 for v in vals]

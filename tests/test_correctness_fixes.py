@@ -1,0 +1,142 @@
+"""Regression tests for the round-1 correctness findings (VERDICT.md
+"What's weak" + ADVICE.md): dictionary-transform group-by, cross-dictionary
+string joins/unions, multi-key packing, truncated %, decimal division,
+USING-join column dedup, signed dense-domain group keys."""
+
+import decimal
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col, lit
+
+
+def test_substring_groupby_merges_colliding_codes(session):
+    # round-1 bug: substr() rewrote the dictionary but left codes distinct,
+    # so "aa1"/"aa2"/"aa3" grouped as three separate "aa" groups
+    pdf = pd.DataFrame({"s": ["aa1", "aa2", "bb1", "aa3"],
+                        "v": np.array([1, 2, 3, 4], dtype=np.int64)})
+    df = session.create_dataframe(pdf)
+    out = (df.group_by(col("s").substr(1, 2).alias("p"))
+           .agg(F.sum(col("v")).alias("sv"))
+           .to_pandas().sort_values("p").reset_index(drop=True))
+    assert list(out["p"]) == ["aa", "bb"]
+    assert list(out["sv"]) == [7, 3]
+
+
+def test_string_join_different_dictionaries(session):
+    # left and right encode strings independently: code equality is
+    # meaningless without unification (ADVICE high-severity)
+    left = session.create_dataframe(pd.DataFrame({
+        "k": ["apple", "banana", "cherry"],
+        "lv": np.array([1, 2, 3], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "k": ["cherry", "apple"],  # reversed insertion order -> codes differ
+        "rv": np.array([30, 10], dtype=np.int64)}))
+    out = (left.join(right, on="k")
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out["k"]) == ["apple", "cherry"]
+    assert list(out["lv"]) == [1, 3]
+    assert list(out["rv"]) == [10, 30]
+
+
+def test_union_string_dictionaries(session):
+    a = session.create_dataframe(pd.DataFrame({"s": ["x", "y"]}))
+    b = session.create_dataframe(pd.DataFrame({"s": ["z", "x"]}))
+    out = sorted(a.union(b).collect().column("s").to_pylist())
+    assert out == ["x", "x", "y", "z"]
+
+
+def test_multi_key_join_wide_keys(session):
+    # two int64 keys cannot pack into 64 bits: the hashed path must
+    # re-verify true equality (round-1: silent 32-bit truncation collided)
+    k1 = np.array([1 << 40, (1 << 40) + 1, 5], dtype=np.int64)
+    k2 = np.array([7, 7, 8], dtype=np.int64)
+    left = session.create_dataframe(pd.DataFrame(
+        {"a": k1, "b": k2, "lv": np.array([1, 2, 3], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame(
+        {"a": k1[:2], "b": k2[:2], "rv": np.array([10, 20], dtype=np.int64)}))
+    out = (left.join(right, on=["a", "b"])
+           .to_pandas().sort_values("lv").reset_index(drop=True))
+    assert list(out["lv"]) == [1, 2]
+    assert list(out["rv"]) == [10, 20]
+
+
+def test_multi_key_join_colliding_low_words(session):
+    # round-1 bug: keys masked to low 32 bits -> (2^33+5, x) joined (5, x)
+    left = session.create_dataframe(pd.DataFrame({
+        "a": np.array([(1 << 33) + 5], dtype=np.int64),
+        "b": np.array([1], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "a": np.array([5], dtype=np.int64),
+        "b": np.array([1], dtype=np.int64),
+        "rv": np.array([99], dtype=np.int64)}))
+    out = left.join(right, on=["a", "b"]).to_pandas()
+    assert len(out) == 0
+
+
+def test_division_by_zero_is_null(session):
+    pdf = pd.DataFrame({"a": np.array([10.0, 20.0]),
+                        "b": np.array([2.0, 0.0])})
+    df = session.create_dataframe(pdf)
+    out = df.select((col("a") / col("b")).alias("q")).to_pandas()
+    assert out["q"][0] == 5.0
+    assert pd.isna(out["q"][1])
+    # integer % 0 is NULL too
+    pdf2 = pd.DataFrame({"a": np.array([10, 20], dtype=np.int64),
+                         "b": np.array([3, 0], dtype=np.int64)})
+    out2 = (session.create_dataframe(pdf2)
+            .select((col("a") % col("b")).alias("m")).to_pandas())
+    assert out2["m"][0] == 1
+    assert pd.isna(out2["m"][1])
+
+
+def test_decimal_division_returns_decimal(session):
+    t = pa.table({
+        "a": pa.array([decimal.Decimal("10.00"), decimal.Decimal("1.00")],
+                      type=pa.decimal128(10, 2)),
+        "b": pa.array([decimal.Decimal("4.00"), decimal.Decimal("3.00")],
+                      type=pa.decimal128(10, 2))})
+    df = session.create_dataframe(t)
+    qt = df.select((col("a") / col("b")).alias("q"))
+    import spark_tpu.types as T
+    assert isinstance(qt.schema.fields[0].dtype, T.DecimalType)
+    out = qt.collect().column("q").to_pylist()
+    assert out[0] == decimal.Decimal("2.5")
+    assert abs(float(out[1]) - 1 / 3) < 1e-6
+
+
+def test_using_join_drops_right_key(session):
+    left = session.create_dataframe(pd.DataFrame({
+        "k": np.array([1, 2], dtype=np.int64),
+        "lv": np.array([1, 2], dtype=np.int64)}))
+    right = session.create_dataframe(pd.DataFrame({
+        "k": np.array([2], dtype=np.int64),
+        "rv": np.array([20], dtype=np.int64)}))
+    out = left.join(right, on="k")
+    assert out.columns == ["k", "lv", "rv"]  # no k_r leak
+
+
+def test_groupby_negative_mod_keys(session):
+    # truncated % yields negative keys; dense-domain path must not merge
+    # them into slot 0
+    pdf = pd.DataFrame({"x": np.array([-7, -4, -1, 1, 4, 7], dtype=np.int64)})
+    df = session.create_dataframe(pdf)
+    out = (df.group_by((col("x") % lit(3)).alias("k"))
+           .agg(F.count().alias("c"))
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    assert list(out["k"]) == [-1, 1]
+    assert list(out["c"]) == [3, 3]
+
+
+def test_groupby_negative_bytes(session):
+    t = pa.table({"b": pa.array([-128, -1, 0, 127, -1], type=pa.int8()),
+                  "v": pa.array([1, 2, 3, 4, 5], type=pa.int64())})
+    df = session.create_dataframe(t)
+    out = (df.group_by(col("b")).agg(F.sum(col("v")).alias("s"))
+           .to_pandas().sort_values("b").reset_index(drop=True))
+    assert list(out["b"]) == [-128, -1, 0, 127]
+    assert list(out["s"]) == [1, 7, 3, 4]
