@@ -65,11 +65,31 @@ class DataFrame:
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
+    _JOIN_ALIASES = {
+        "outer": "full", "full_outer": "full", "fullouter": "full",
+        "left_outer": "left", "leftouter": "left",
+        "right_outer": "right", "rightouter": "right",
+        "semi": "left_semi", "leftsemi": "left_semi",
+        "anti": "left_anti", "leftanti": "left_anti",
+    }
+
+    def cross_join(self, other: "DataFrame",
+                   condition: Optional[Expression] = None) -> "DataFrame":
+        """Cartesian product (reference: Dataset.crossJoin), lowered to a
+        constant-key equi-join so the expansion kernel produces |L|x|R|."""
+        from .expr import Literal
+        one = Literal(1)
+        return self._with(L.Join(self.plan, other.plan, [one], [one],
+                                 "inner", condition))
+
+    crossJoin = cross_join
+
     def join(self, other: "DataFrame", on=None, how: str = "inner",
              left_on=None, right_on=None,
              condition: Optional[Expression] = None) -> "DataFrame":
-        if how == "right":
-            raise AnalysisError("right join: call other.join(self, how='left')")
+        how = self._JOIN_ALIASES.get(how, how)
+        if how == "cross":
+            return self.cross_join(other, condition)
         names = None
         if on is not None:
             names = [on] if isinstance(on, str) else list(on)
@@ -83,11 +103,21 @@ class DataFrame:
         join = L.Join(self.plan, other.plan, lk, rk, how, condition)
         if names is not None and how not in ("left_semi", "left_anti"):
             # USING-join semantics (reference Dataset.join(df, usingColumns)):
-            # the right side's copy of each key column is dropped
+            # one output key column — the left one (coalesced with the
+            # right copy for right/full outer), right copies dropped
+            from .expr import Coalesce
             name_map = join.right_name_map()
             drop = {name_map[n] for n in names if n in name_map}
-            keep = [n for n in join.schema().names if n not in drop]
-            return self._with(L.Project(join, [ColumnRef(n) for n in keep]))
+            exprs: List[Expression] = []
+            for n in join.schema().names:
+                if n in drop:
+                    continue
+                if n in names and how in ("right", "full"):
+                    exprs.append(Alias(Coalesce(ColumnRef(n),
+                                                ColumnRef(name_map[n])), n))
+                else:
+                    exprs.append(ColumnRef(n))
+            return self._with(L.Project(join, exprs))
         return self._with(join)
 
     def sort(self, *orders) -> "DataFrame":

@@ -96,16 +96,7 @@ class QueryExecution:
             node.children = new_children
         return node
 
-    def execute_batch(self) -> Tuple[Batch, Dict, Dict]:
-        """Run the query, returning (device Batch, flags, metrics)."""
-        root = self._materialize_streaming(self.executed_plan)
-        scans: List[P.LeafExec] = []
-        self._collect_scans(root, scans)
-
-        t0 = time.perf_counter()
-        scan_batches = [s.load() for s in scans]
-        self.phase_times["ingest"] = time.perf_counter() - t0
-
+    def _compile_stage(self, root: P.PhysicalPlan):
         conf = self.session.conf
         key = root.describe()
         fn = self.session._stage_cache.get(key)
@@ -127,18 +118,50 @@ class QueryExecution:
 
             fn = jax.jit(run)
             self.session._stage_cache[key] = fn
+        return fn
+
+    @staticmethod
+    def _set_join_cap(root: P.PhysicalPlan, tag: str, cap: int) -> None:
+        for c in root.children:
+            QueryExecution._set_join_cap(c, tag, cap)
+        if isinstance(root, P.JoinExec) and root.tag == tag:
+            root.out_cap = cap
+
+    def execute_batch(self) -> Tuple[Batch, Dict, Dict]:
+        """Run the query, returning (device Batch, flags, metrics).
+
+        Joins whose many-to-many expansion overflows the seeded output
+        capacity surface a `join_overflow_<tag>` flag plus the true row
+        total in `join_rows_<tag>`; the loop below re-jits those joins
+        with a sufficient static capacity (the AQE-style stats->re-plan
+        host loop, `AdaptiveSparkPlanExec.scala:64`)."""
+        from ..columnar import bucket_capacity
+        root = self._materialize_streaming(self.executed_plan)
+        scans: List[P.LeafExec] = []
+        self._collect_scans(root, scans)
 
         t0 = time.perf_counter()
-        batch, flags, metrics = fn(scan_batches)
+        scan_batches = [s.load() for s in scans]
+        self.phase_times["ingest"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _attempt in range(8):
+            fn = self._compile_stage(root)
+            batch, flags, metrics = fn(scan_batches)
+            overflow = [k for k, v in flags.items()
+                        if k.startswith("join_overflow_")
+                        and bool(np.asarray(v))]
+            if not overflow:
+                break
+            for k in overflow:
+                tag = k[len("join_overflow_"):]
+                total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                self._set_join_cap(root, tag,
+                                   bucket_capacity(max(total, 8)))
+        else:
+            raise RuntimeError("join output capacity did not converge")
         batch = jax.block_until_ready(batch)
         self.phase_times["execution"] = time.perf_counter() - t0
-
-        if flags.get("join_build_dup") is not None and \
-                bool(np.asarray(flags["join_build_dup"])):
-            raise RuntimeError(
-                "join build side contains duplicate keys; the sorted-build "
-                "FK join requires unique build keys (plan a different "
-                "strategy or aggregate the build side first)")
         return batch, flags, metrics
 
     def collect(self) -> pa.Table:

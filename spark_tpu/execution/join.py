@@ -1,17 +1,24 @@
-"""Equi-join kernel: sorted-build + binary-search probe.
+"""Equi-join kernels: sorted-build binary-search with many-to-many expansion.
 
-Replaces the reference's hash join tier (`HashedRelation.scala:41`,
-`BroadcastHashJoinExec.scala:40`, `ShuffledHashJoinExec.scala:37`) with a
-sort+searchsorted formulation that XLA maps well onto TPU: the build side
-is sorted once (`lax.sort`), each probe key binary-searches
-(`jnp.searchsorted`), and matched build rows are gathered. O((m+n) log n)
-with fully static shapes.
+Replaces the reference's join tier (`SortMergeJoinExec.scala:36`,
+`HashedRelation.scala:41`, `BroadcastHashJoinExec.scala:40`,
+`ShuffledHashJoinExec.scala:37`) with a sort+searchsorted formulation that
+XLA maps well onto TPU:
 
-This kernel requires *unique* build-side keys (the FK-join case: every
-TPC-H join probes a primary key). Duplicate build keys are detected on
-device and surfaced as a `dup_detected` flag the executor checks —
-many-to-many joins are planned to expand via a different strategy
-(SURVEY.md section 7, "hard parts").
+- the build side is sorted once (`lax.sort`);
+- each probe key binary-searches its match *range* [lo, hi)
+  (`jnp.searchsorted` left/right), so duplicate build keys are handled;
+- output rows are produced by prefix-sum expansion into a statically
+  shaped output: out row r maps back to probe row p via a second
+  searchsorted over the row-offset array, and to build row lo[p]+(r-off[p]).
+
+Output capacity is a static trace-time parameter. The executor seeds it
+with the probe capacity (exact for FK joins, the TPC-H shape) and, when
+the traced total exceeds it, reads the real total from a metric and
+re-jits with a sufficient capacity — the host-side stats->re-plan loop of
+the reference's AQE (`AdaptiveSparkPlanExec.scala:64`) in miniature.
+
+All shapes are static; everything fuses into the enclosing stage.
 """
 
 from __future__ import annotations
@@ -30,7 +37,7 @@ from ..expr import Vec
 def build_sorted(key: Vec, sel) -> Tuple:
     """Sort build side by key; invalid rows pushed to the end.
 
-    Returns (sorted_keys, perm, num_valid, dup_detected)."""
+    Returns (sorted_keys, perm, num_valid, valid_mask_sorted)."""
     cap = key.data.shape[0]
     invalid = jnp.zeros((cap,), jnp.int8)
     if sel is not None:
@@ -48,35 +55,69 @@ def build_sorted(key: Vec, sel) -> Tuple:
     else:
         sentinel = jnp.asarray(np.iinfo(np.dtype(keys_s.dtype)).max, keys_s.dtype)
     keys_s = jnp.where(valid_s, keys_s, sentinel)
-    adj_dup = (keys_s[1:] == keys_s[:-1]) & valid_s[1:] & valid_s[:-1]
-    dup = jnp.any(adj_dup)
-    return keys_s, perm, n_valid, valid_s, dup
+    return keys_s, perm, n_valid, valid_s
 
 
-def probe(sorted_keys, perm, n_valid, probe_key: Vec, probe_sel):
-    """Binary-search probe. Returns (match_idx into build batch, found mask)."""
-    pos = jnp.searchsorted(sorted_keys, probe_key.data)
-    pos_c = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
-    hit_key = jnp.take(sorted_keys, pos_c)
-    found = (pos < n_valid) & (hit_key == probe_key.data)
+def match_ranges(sorted_keys, n_valid, probe_key: Vec, probe_sel):
+    """Binary-search each probe key's build match range.
+
+    Returns (lo, cnt): build rows [lo, lo+cnt) in sorted order match.
+    cnt is 0 for unmatched/invalid/unselected probe rows."""
+    lo = jnp.searchsorted(sorted_keys, probe_key.data, side="left")
+    hi = jnp.searchsorted(sorted_keys, probe_key.data, side="right")
+    lo = jnp.minimum(lo, n_valid).astype(jnp.int32)
+    hi = jnp.minimum(hi, n_valid).astype(jnp.int32)
+    found = hi > lo
     if probe_key.validity is not None:
         found = found & probe_key.validity
     if probe_sel is not None:
         found = found & probe_sel
-    match_idx = jnp.take(perm, pos_c)
-    return match_idx, found
+    cnt = jnp.where(found, hi - lo, 0).astype(jnp.int32)
+    return lo, cnt
 
 
-def gather_build_columns(build: Batch, match_idx, found,
-                         name_map: List[Tuple[str, str]]) -> List[Tuple[str, Column]]:
-    """Gather build-side columns at match_idx; validity &= found."""
+def expand(lo, cnt_key, cnt_eff, perm, out_cap: int):
+    """Prefix-sum expansion of match ranges into a static-capacity output.
+
+    cnt_key[p] = number of key-matched build rows for probe row p;
+    cnt_eff[p] = rows to emit for p (== cnt_key, or max(cnt_key,1) for
+    outer joins that null-extend unmatched probe rows).
+
+    Returns (p, build_idx, is_pair, valid, total):
+      p[r]        probe row of output row r
+      build_idx[r] build row (meaningful when is_pair[r])
+      is_pair[r]  r is a key-matched pair (False => null-extension row)
+      valid[r]    r < total emitted rows
+      total       traced scalar: rows actually produced (host checks
+                  against out_cap and re-jits on overflow)
+    """
+    cap = cnt_eff.shape[0]
+    off = jnp.cumsum(cnt_eff) - cnt_eff  # exclusive prefix sum
+    total = off[-1] + cnt_eff[-1]
+    r = jnp.arange(out_cap, dtype=jnp.int32)
+    p = jnp.searchsorted(off, r, side="right").astype(jnp.int32) - 1
+    p = jnp.clip(p, 0, cap - 1)
+    j = r - jnp.take(off, p)
+    is_pair = j < jnp.take(cnt_key, p)
+    build_pos = jnp.clip(jnp.take(lo, p) + j, 0, perm.shape[0] - 1)
+    build_idx = jnp.take(perm, build_pos)
+    valid = r < total
+    return p, build_idx, is_pair & valid, valid, total
+
+
+def gather_columns(batch: Batch, idx, present,
+                   name_map: Sequence[Tuple[str, str]]
+                   ) -> List[Tuple[str, Column]]:
+    """Gather columns at idx; validity &= present (rows where the side
+    contributes no value — null-extensions — become NULL)."""
     out = []
     for src_name, out_name in name_map:
-        col = build.columns[src_name]
-        data = jnp.take(col.data, match_idx)
+        col = batch.columns[src_name]
+        data = jnp.take(col.data, idx)
         if col.validity is not None:
-            validity = jnp.take(col.validity, match_idx) & found
+            validity = jnp.take(col.validity, idx) & present
         else:
-            validity = found
-        out.append((out_name, Column(data, col.dtype, validity, col.dictionary)))
+            validity = present
+        out.append((out_name, Column(data, col.dtype, validity,
+                                     col.dictionary)))
     return out

@@ -174,6 +174,20 @@ def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
     found = find_streamable_chain(agg)
     if found is None:
         return None
+    # a string group key *derived* from a column (substr, concat, ...)
+    # rebuilds its (deduped) dictionary per chunk, so codes are not stable
+    # across chunks and the carried tables would mix encodings; only bare
+    # column references stream (their dictionary grows append-only via
+    # DictUnifier). Derived keys fall back to whole-input execution.
+    from ..expr import Alias, ColumnRef
+    child_schema = agg.child.schema()
+    for g in agg.group_exprs:
+        e = g
+        while isinstance(e, Alias):
+            e = e.child
+        if not isinstance(e, ColumnRef) and \
+                isinstance(e.dtype(child_schema), T.StringType):
+            return None
     chain, leaf = found
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
     if isinstance(leaf, P.RangeExec):

@@ -967,6 +967,61 @@ class Substring(Expression):
         return f"substring({self.children[0]!r},{self.start},{self.length})"
 
 
+class Coalesce(Expression):
+    """First non-NULL argument (reference: nullExpressions.scala Coalesce)."""
+
+    def __init__(self, *children: Expression):
+        if not children:
+            raise AnalysisError("coalesce requires at least one argument")
+        self.children = tuple(children)
+
+    def dtype(self, schema):
+        out = self.children[0].dtype(schema)
+        for c in self.children[1:]:
+            out = T.common_type(out, c.dtype(schema))
+        return out
+
+    def nullable(self, schema):
+        return all(c.nullable(schema) for c in self.children)
+
+    def eval(self, batch):
+        out_dtype = self.dtype(batch.schema())
+        if isinstance(out_dtype, T.StringType):
+            return self._eval_string(batch)
+        acc = cast_vec(self.children[0].eval(batch), out_dtype)
+        data, validity = acc.data, acc.validity
+        for c in self.children[1:]:
+            if validity is None:
+                break
+            v = cast_vec(c.eval(batch), out_dtype)
+            vval = v.validity if v.validity is not None else \
+                jnp.ones((), jnp.bool_)
+            data = jnp.where(validity, data, v.data)
+            validity = validity | jnp.broadcast_to(vval, np.shape(validity))
+        return Vec(data, out_dtype, validity)
+
+    def _eval_string(self, batch):
+        from .columnar import unify_string_columns
+        acc = self.children[0].eval(batch)
+        data, validity, dictionary = acc.data, acc.validity, acc.dictionary
+        for c in self.children[1:]:
+            if validity is None:
+                break
+            v = c.eval(batch)
+            if dictionary is None or v.dictionary is None:
+                raise AnalysisError("coalesce on strings requires dictionaries")
+            data, v_data, dictionary = unify_string_columns(
+                data, dictionary, v.data, v.dictionary)
+            vval = v.validity if v.validity is not None else \
+                jnp.ones((), jnp.bool_)
+            data = jnp.where(validity, data, v_data)
+            validity = validity | jnp.broadcast_to(vval, np.shape(validity))
+        return Vec(data, T.STRING, validity, dictionary)
+
+    def __repr__(self):
+        return f"coalesce({', '.join(repr(c) for c in self.children)})"
+
+
 class CaseWhen(Expression):
     def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
                  otherwise: Optional[Expression] = None):

@@ -198,7 +198,7 @@ class Aggregate(LogicalPlan):
                 f"aggs={list(self.agg_exprs)!r})")
 
 
-JOIN_TYPES = ("inner", "left", "right", "left_semi", "left_anti")
+JOIN_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti")
 
 
 class Join(LogicalPlan):

@@ -584,20 +584,31 @@ class LimitExec(UnaryExec):
 
 
 class JoinExec(PhysicalPlan):
-    """Sorted-build binary-search equi-join (see execution/join.py).
-    Build side = right child. Requires unique build keys (FK-join); a
-    traced `dup` flag is surfaced for the executor to verify."""
+    """General equi-join: sorted-build binary-search with prefix-sum
+    expansion (execution/join.py). Build side = right child. Supports
+    many-to-many matches, inner/left/right/full outer, semi/anti, and
+    residual (non-equi) conditions for every join type.
+
+    `out_cap` is the static capacity of the expanded-pair block; None
+    defaults to the probe capacity (exact for FK joins). When the traced
+    row total overflows it, the executor reads the real total from the
+    `join_rows_<tag>` metric and re-jits with a larger capacity — the
+    stats->re-plan loop of the reference's AQE
+    (`AdaptiveSparkPlanExec.scala:64`)."""
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  how: str, condition: Optional[Expression],
-                 out_schema: T.Schema):
+                 out_schema: T.Schema, out_cap: Optional[int] = None,
+                 tag: str = "j0"):
         self.children = (left, right)
         self.left_keys = tuple(left_keys)
         self.right_keys = tuple(right_keys)
         self.how = how
         self.condition = condition
         self._schema = out_schema
+        self.out_cap = out_cap
+        self.tag = tag
 
     @property
     def left(self):
@@ -618,68 +629,167 @@ class JoinExec(PhysicalPlan):
     def output_partitioning(self):
         return self.left.output_partitioning()
 
-    def compute(self, ctx, inputs):
-        probe_batch, build_batch = inputs
-        lvecs = [k.eval(probe_batch) for k in self.left_keys]
-        rvecs = [k.eval(build_batch) for k in self.right_keys]
+    def _eval_keys(self, probe_batch, build_batch):
+        def bcast(v: Vec, cap: int) -> Vec:
+            # literal keys (cross join lowers to a constant-key equi-join)
+            if v.data is not None and np.ndim(v.data) == 0:
+                return Vec(jnp.broadcast_to(v.data, (cap,)), v.dtype,
+                           v.validity, v.dictionary)
+            return v
+
+        lvecs = [bcast(k.eval(probe_batch), probe_batch.capacity)
+                 for k in self.left_keys]
+        rvecs = [bcast(k.eval(build_batch), build_batch.capacity)
+                 for k in self.right_keys]
         lvecs, rvecs = _unify_key_dictionaries(lvecs, rvecs)
         if len(lvecs) != 1:
             lk, rk, exact = _pack_key_pair(lvecs, rvecs)
         else:
             lk, rk = lvecs[0], rvecs[0]
             exact = True
-        keys_s, perm, n_valid, valid_s, dup = join_kernels.build_sorted(
+        return lvecs, rvecs, lk, rk, exact
+
+    def compute(self, ctx, inputs):
+        probe_batch, build_batch = inputs
+        lvecs, rvecs, lk, rk, exact = self._eval_keys(probe_batch, build_batch)
+        keys_s, perm, n_valid, _valid_s = join_kernels.build_sorted(
             rk, build_batch.selection)
-        ctx.add_flag("join_build_dup", dup)
-        match_idx, found = join_kernels.probe(keys_s, perm, n_valid, lk,
-                                              probe_batch.selection)
-        if not exact:
-            # hashed pack: verify true per-key equality on the matched row
-            for lv, rv in zip(lvecs, rvecs):
-                found = found & (lv.data == jnp.take(rv.data, match_idx))
-                if rv.validity is not None:
-                    found = found & jnp.take(rv.validity, match_idx)
+        lo, cnt = join_kernels.match_ranges(keys_s, n_valid, lk,
+                                            probe_batch.selection)
         psel = probe_batch.selection_mask()
+        semi_anti = self.how in ("left_semi", "left_anti")
 
-        if self.how == "left_semi":
-            return probe_batch.with_selection(psel & found)
-        if self.how == "left_anti":
-            null_key = jnp.zeros_like(found)
-            if lk.validity is not None:
-                null_key = ~lk.validity
-            return probe_batch.with_selection(psel & ~found & ~null_key)
+        if semi_anti and exact and self.condition is None:
+            found = cnt > 0
+            if self.how == "left_semi":
+                return probe_batch.with_selection(psel & found)
+            return probe_batch.with_selection(psel & ~found)
 
-        # assemble: probe columns + gathered build columns (renamed per schema)
-        out_names = self._schema.names
-        n_left = len(probe_batch.columns)
+        probe_cap = probe_batch.capacity
+        build_cap = build_batch.capacity
+        out_cap = self.out_cap if self.out_cap is not None else probe_cap
+        outer_probe = self.how in ("left", "full")
+        if outer_probe:
+            cnt_eff = jnp.where(psel, jnp.maximum(cnt, 1), 0)
+        else:
+            cnt_eff = jnp.where(psel, cnt, 0)
+        p, build_idx, is_pair, valid, total = join_kernels.expand(
+            lo, cnt, cnt_eff, perm, out_cap)
+        ctx.add_metric(f"join_rows_{self.tag}", total)
+        ctx.add_flag(f"join_overflow_{self.tag}", total > out_cap)
+
+        pair_pass = is_pair
+        if not exact:
+            # hashed key pack: verify true per-key equality on each pair
+            for lvec, rvec in zip(lvecs, rvecs):
+                eq = jnp.take(lvec.data, p) == jnp.take(rvec.data, build_idx)
+                if lvec.validity is not None:
+                    eq = eq & jnp.take(lvec.validity, p)
+                if rvec.validity is not None:
+                    eq = eq & jnp.take(rvec.validity, build_idx)
+                pair_pass = pair_pass & eq
+
+        # assemble the expanded block: probe columns at p, build at build_idx
         left_names = list(probe_batch.columns.keys())
+        if semi_anti:
+            # semi/anti output is probe-shaped; the pair block exists only
+            # so the residual condition can see build columns. Collisions
+            # use the same `_r` suffix convention as Join.right_name_map()
+            # so one condition expression works for every join type.
+            taken = set(left_names)
+            out_names = list(left_names)
+            for n in build_batch.columns.keys():
+                name = n
+                while name in taken:
+                    name = name + "_r"
+                out_names.append(name)
+                taken.add(name)
+        else:
+            out_names = self._schema.names
+        n_left = len(left_names)
         cols: Dict[str, Column] = {}
-        for name, out_name in zip(left_names, out_names[:n_left]):
-            cols[out_name] = probe_batch.columns[name]
-        name_map = list(zip(build_batch.columns.keys(), out_names[n_left:]))
-        for out_name, col in join_kernels.gather_build_columns(
-                build_batch, match_idx, found, name_map):
+        for (out_name, col) in join_kernels.gather_columns(
+                probe_batch, p, valid,
+                list(zip(left_names, out_names[:n_left]))):
+            cols[out_name] = col
+        build_name_map = list(zip(build_batch.columns.keys(),
+                                  out_names[n_left:]))
+        for (out_name, col) in join_kernels.gather_columns(
+                build_batch, build_idx, pair_pass, build_name_map):
             cols[out_name] = col
 
-        if self.how == "inner":
-            sel = psel & found
-        else:  # left
-            sel = psel
-        out = Batch(cols, sel)
         if self.condition is not None:
-            v = self.condition.eval(out)
+            out_probe = Batch(cols, valid & pair_pass)
+            v = self.condition.eval(out_probe)
             keep = v.data if v.validity is None else (v.data & v.validity)
-            if self.how == "inner":
-                out = out.with_selection(sel & keep)
-            else:
-                raise AnalysisError(
-                    "residual join condition only supported for inner joins")
-        return out
+            pair_pass = pair_pass & keep
+            # pairs dropped by the residual must also null the build side
+            for out_name, col in join_kernels.gather_columns(
+                    build_batch, build_idx, pair_pass, build_name_map):
+                cols[out_name] = col
+
+        # per-probe-row "any pair survived" (drives null-extension + semi/anti)
+        scatter_p = jnp.where(valid & pair_pass, p, probe_cap)
+        any_pass = jnp.zeros((probe_cap,), jnp.bool_).at[scatter_p].max(
+            jnp.ones_like(pair_pass), mode="drop")
+
+        if semi_anti:
+            if self.how == "left_semi":
+                return probe_batch.with_selection(psel & any_pass)
+            return probe_batch.with_selection(psel & ~any_pass)
+
+        if outer_probe:
+            # keep surviving pairs; for probe rows with none, keep exactly
+            # the first emitted row as a null-extended row
+            off_p = jnp.take(
+                jnp.cumsum(cnt_eff) - cnt_eff, p)
+            is_first = jnp.arange(out_cap, dtype=jnp.int32) == off_p
+            null_ext = is_first & ~jnp.take(any_pass, p)
+            sel = valid & (pair_pass | null_ext)
+            # null-extended rows must show NULL build columns even when
+            # they reused a failed pair slot
+            for out_name, col in join_kernels.gather_columns(
+                    build_batch, build_idx, pair_pass & ~null_ext,
+                    build_name_map):
+                cols[out_name] = col
+        else:
+            sel = valid & pair_pass
+
+        if self.how in ("right", "full"):
+            # append build rows no surviving pair touched, null-extended left
+            scatter_b = jnp.where(valid & pair_pass, build_idx, build_cap)
+            matched_b = jnp.zeros((build_cap,), jnp.bool_).at[scatter_b].max(
+                jnp.ones_like(pair_pass), mode="drop")
+            bsel = build_batch.selection_mask()
+            app_sel = bsel & ~matched_b
+            app_cols: Dict[str, Column] = {}
+            for name, out_name in zip(left_names, out_names[:n_left]):
+                src = probe_batch.columns[name]
+                app_cols[out_name] = Column(
+                    jnp.zeros((build_cap,), src.data.dtype), src.dtype,
+                    jnp.zeros((build_cap,), jnp.bool_), src.dictionary)
+            for name, out_name in build_name_map:
+                src = build_batch.columns[name]
+                app_cols[out_name] = Column(src.data, src.dtype,
+                                            src.validity, src.dictionary)
+            merged: Dict[str, Column] = {}
+            for out_name in cols:
+                a, b = cols[out_name], app_cols[out_name]
+                av = a.validity if a.validity is not None else \
+                    jnp.ones((out_cap,), jnp.bool_)
+                bv = b.validity if b.validity is not None else \
+                    jnp.ones((build_cap,), jnp.bool_)
+                merged[out_name] = Column(
+                    jnp.concatenate([a.data, b.data.astype(a.data.dtype)]),
+                    a.dtype, jnp.concatenate([av, bv]), a.dictionary)
+            return Batch(merged, jnp.concatenate([sel, app_sel]))
+
+        return Batch(cols, sel)
 
     def simple_string(self):
         return (f"JoinExec({self.how}, {[repr(k) for k in self.left_keys]} = "
                 f"{[repr(k) for k in self.right_keys]}, "
-                f"cond={self.condition!r})")
+                f"cond={self.condition!r}, cap={self.out_cap})")
 
 
 def _unify_key_dictionaries(lvecs: List[Vec], rvecs: List[Vec]

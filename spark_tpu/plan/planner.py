@@ -22,7 +22,23 @@ from . import physical as P
 def plan_physical(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
     phys = _convert(plan, conf)
     phys = ensure_requirements(phys, conf)
+    _assign_join_tags(phys)
     return phys
+
+
+def _assign_join_tags(plan: P.PhysicalPlan) -> None:
+    """Stable per-node tags for join overflow flags/metrics (the executor's
+    capacity-retry loop keys on them)."""
+    counter = [0]
+
+    def walk(node):
+        for c in node.children:
+            walk(c)
+        if isinstance(node, P.JoinExec):
+            node.tag = f"j{counter[0]}"
+            counter[0] += 1
+
+    walk(plan)
 
 
 def _convert(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
@@ -39,9 +55,6 @@ def _convert(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
                                    plan.group_exprs, plan.agg_exprs,
                                    mode="complete")
     if isinstance(plan, L.Join):
-        if plan.how == "right":
-            raise AnalysisError(
-                "right join: rewrite as left join with swapped inputs")
         return P.JoinExec(_convert(plan.left, conf), _convert(plan.right, conf),
                           plan.left_keys, plan.right_keys, plan.how,
                           plan.condition, plan.schema())

@@ -103,14 +103,19 @@ def test_join_semi_anti(session):
     assert sorted(anti["k"]) == [1, 3]
 
 
-def test_join_dup_build_keys_raises(session):
+def test_join_many_to_many(session):
+    # duplicate build keys expand (round 1 aborted at runtime)
     left = session.create_dataframe(pd.DataFrame({
-        "k": np.array([1, 2], dtype=np.int64)}))
+        "k": np.array([1, 2, 2], dtype=np.int64),
+        "lv": np.array([10, 20, 21], dtype=np.int64)}))
     right = session.create_dataframe(pd.DataFrame({
-        "k": np.array([2, 2], dtype=np.int64),
-        "v": np.array([1, 2], dtype=np.int64)}))
-    with pytest.raises(RuntimeError, match="duplicate"):
-        left.join(right, on="k").collect()
+        "k": np.array([2, 2, 3], dtype=np.int64),
+        "rv": np.array([1, 2, 3], dtype=np.int64)}))
+    out = (left.join(right, on="k")
+           .to_pandas().sort_values(["lv", "rv"]).reset_index(drop=True))
+    # 2 left rows with k=2 x 2 right rows with k=2 = 4 rows
+    assert list(out["lv"]) == [20, 20, 21, 21]
+    assert list(out["rv"]) == [1, 2, 1, 2]
 
 
 def test_sort_limit(session):
