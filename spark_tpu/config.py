@@ -144,3 +144,10 @@ CASE_SENSITIVE = register(
 ANSI_ENABLED = register(
     "spark_tpu.sql.ansi.enabled", False,
     doc="ANSI mode: overflow/ invalid-cast errors instead of nulls.")
+
+MESH_SIZE = register(
+    "spark_tpu.sql.mesh.size", 0,
+    doc="Number of devices on the data axis of the SPMD mesh. 0 or 1 "
+        "runs single-chip; >1 shards leaves over the mesh and lowers "
+        "exchanges to ICI collectives (all_to_all/all_gather/psum). "
+        "The SPMD analog of spark.default.parallelism.")

@@ -15,6 +15,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
@@ -96,28 +97,70 @@ class QueryExecution:
             node.children = new_children
         return node
 
-    def _compile_stage(self, root: P.PhysicalPlan):
+    def _compile_stage(self, root: P.PhysicalPlan, mesh=None):
         conf = self.session.conf
-        key = root.describe()
+        n = int(mesh.devices.size) if mesh is not None else 1
+        key = root.describe() + (f"#mesh{n}" if mesh is not None else "")
         fn = self.session._stage_cache.get(key)
-        if fn is None:
+        if fn is not None:
+            return fn
+
+        def replay_root(ctx, inputs):
+            counter = [0]
+
+            def replay(node: P.PhysicalPlan) -> Batch:
+                if getattr(node, "needs_input", False):
+                    b = inputs[counter[0]]
+                    counter[0] += 1
+                    return b
+                child_batches = [replay(c) for c in node.children]
+                return node.compute(ctx, child_batches)
+
+            return replay(root)
+
+        if mesh is None:
             def run(inputs):
                 ctx = P.ExecContext(conf)
-                counter = [0]
-
-                def replay(node: P.PhysicalPlan) -> Batch:
-                    if getattr(node, "needs_input", False):
-                        b = inputs[counter[0]]
-                        counter[0] += 1
-                        return b
-                    child_batches = [replay(c) for c in node.children]
-                    return node.compute(ctx, child_batches)
-
-                out = replay(root)
+                out = replay_root(ctx, inputs)
                 return out, ctx.flags, ctx.metrics
 
             fn = jax.jit(run)
-            self.session._stage_cache[key] = fn
+        else:
+            from jax.sharding import PartitionSpec as Psp
+            from jax import shard_map
+            from ..parallel import stripe_batch
+            from ..parallel.mesh import AXIS
+
+            # sorted/limited/global-agg results are replicated on every
+            # shard; each shard emits its contiguous stripe so the
+            # out_spec reassembles the full (ordered) result exactly once
+            replicated_out = isinstance(
+                root.output_partitioning(),
+                (P.SinglePartition, P.Replicated))
+
+            def run_shard(inputs, _token):
+                ctx = P.ExecContext(conf, axis_name=AXIS, n_shards=n)
+                out = replay_root(ctx, inputs)
+                if replicated_out:
+                    out = stripe_batch(out, ctx)
+                # AQE stats channel: reduce flags/metrics to replicated
+                # scalars (pmax for per-shard capacity stats, psum else)
+                flags = {k: jax.lax.psum(
+                    jnp.asarray(v).astype(jnp.int32), AXIS)
+                    for k, v in ctx.flags.items()}
+                metrics = {}
+                for k, v in ctx.metrics.items():
+                    red = jax.lax.pmax if k.startswith("join_rows_") \
+                        else jax.lax.psum
+                    metrics[k] = red(jnp.asarray(v), AXIS)
+                return out, flags, metrics
+
+            fn = jax.jit(shard_map(
+                run_shard, mesh=mesh,
+                in_specs=(Psp(AXIS), Psp(AXIS)),
+                out_specs=(Psp(AXIS), Psp(), Psp()),
+                check_vma=False))
+        self.session._stage_cache[key] = fn
         return fn
 
     @staticmethod
@@ -136,18 +179,35 @@ class QueryExecution:
         with a sufficient static capacity (the AQE-style stats->re-plan
         host loop, `AdaptiveSparkPlanExec.scala:64`)."""
         from ..columnar import bucket_capacity
-        root = self._materialize_streaming(self.executed_plan)
+        from ..parallel.mesh import get_mesh
+        mesh = get_mesh(self.session.conf)
+        if mesh is None:
+            root = self._materialize_streaming(self.executed_plan)
+        else:
+            # the SPMD program IS the streaming discipline across shards;
+            # per-chunk host streaming composes with it in a later round
+            root = self.executed_plan
         scans: List[P.LeafExec] = []
         self._collect_scans(root, scans)
 
         t0 = time.perf_counter()
         scan_batches = [s.load() for s in scans]
+        if mesh is not None:
+            from ..parallel import pad_batch_to_multiple
+            n = int(mesh.devices.size)
+            scan_batches = [pad_batch_to_multiple(b, n) for b in scan_batches]
         self.phase_times["ingest"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
+        token = None
+        if mesh is not None:
+            token = jnp.zeros((int(mesh.devices.size),), jnp.int32)
         for _attempt in range(8):
-            fn = self._compile_stage(root)
-            batch, flags, metrics = fn(scan_batches)
+            fn = self._compile_stage(root, mesh)
+            if mesh is None:
+                batch, flags, metrics = fn(scan_batches)
+            else:
+                batch, flags, metrics = fn(scan_batches, token)
             overflow = [k for k, v in flags.items()
                         if k.startswith("join_overflow_")
                         and bool(np.asarray(v))]

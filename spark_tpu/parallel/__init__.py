@@ -1,0 +1,27 @@
+"""Multi-chip SPMD execution over a `jax.sharding.Mesh`.
+
+The TPU-native replacement for the reference's entire distributed runtime
+tier (SURVEY.md sections 2.5/2.6): instead of a DAGScheduler cutting
+stages into tasks (`scheduler/DAGScheduler.scala:119`), shuffle files
+(`shuffle/sort/SortShuffleManager.scala:73`), Netty block transfer, and a
+MapOutputTracker, the whole physical plan runs as ONE gang-scheduled SPMD
+program via `shard_map` over a 1-D "data" mesh axis:
+
+- leaves shard rows over the axis (a scan batch is split; Range
+  synthesizes only its stripe);
+- `ExchangeExec(HashPartitioning)` lowers to device radix-partition +
+  `jax.lax.all_to_all` over ICI (parallel/shuffle.py) — the shuffle;
+- `ExchangeExec(SinglePartition | Replicated)` lowers to
+  `jax.lax.all_gather` — broadcast / global collapse;
+- aggregates are planned partial -> exchange -> final (`AggUtils.scala`
+  analog, plan/planner.py), so only small accumulator tables ride ICI;
+- flags/metrics are `psum`/`pmax`-reduced back to the host — the AQE
+  stats channel.
+"""
+
+from .mesh import get_mesh, mesh_size
+from .shuffle import (all_gather_batch, exchange_hash, pad_batch_to_multiple,
+                      shard_batch_spec, stripe_batch)
+
+__all__ = ["get_mesh", "mesh_size", "exchange_hash", "all_gather_batch",
+           "stripe_batch", "pad_batch_to_multiple", "shard_batch_spec"]

@@ -1,0 +1,35 @@
+"""Mesh construction: the device topology the engine schedules onto.
+
+One 1-D "data" axis for now (row sharding + exchanges); the Mesh API
+generalizes to multi-axis layouts (e.g. ("data", "model")) without
+changing operator code, because every collective names its axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+AXIS = "data"
+
+
+def mesh_size(conf) -> int:
+    n = int(conf.get("spark_tpu.sql.mesh.size"))
+    return max(1, n)
+
+
+def get_mesh(conf) -> Optional[Mesh]:
+    """Build the 1-D data mesh from conf, or None for single-chip."""
+    n = mesh_size(conf)
+    if n <= 1:
+        return None
+    devices = jax.devices()
+    if len(devices) < n:
+        raise RuntimeError(
+            f"mesh.size={n} but only {len(devices)} devices visible "
+            f"({[d.platform for d in devices[:4]]}...); for CI use "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
+    import numpy as np
+    return Mesh(np.array(devices[:n]), (AXIS,))

@@ -22,7 +22,7 @@ import numpy as np
 from .. import types as T
 from ..columnar import Batch, Column, bucket_capacity
 from ..config import Conf
-from ..expr import (AnalysisError, Expression, SortOrder, Vec)
+from ..expr import (Alias, AnalysisError, Expression, SortOrder, Vec)
 from ..expr_agg import AggExpr
 from ..execution import aggregate as agg_kernels
 from ..execution import join as join_kernels
@@ -106,11 +106,18 @@ class UnknownPartitioning(Partitioning):
 
 class ExecContext:
     """Per-execution state threaded through `compute` calls: conf, runtime
-    flags (traced scalars surfaced to the host, e.g. duplicate-build-key
-    detection), and per-operator metrics (the SQLMetrics analog)."""
+    flags (traced scalars surfaced to the host, e.g. join-capacity
+    overflow), and per-operator metrics (the SQLMetrics analog).
 
-    def __init__(self, conf: Conf):
+    When running inside `shard_map` over a mesh, `axis_name`/`n_shards`
+    identify the data axis: leaves synthesize only their stripe and
+    ExchangeExec lowers to collectives (parallel/shuffle.py)."""
+
+    def __init__(self, conf: Conf, axis_name: Optional[str] = None,
+                 n_shards: int = 1):
         self.conf = conf
+        self.axis_name = axis_name
+        self.n_shards = n_shards
         self.flags: Dict[str, object] = {}
         self.metrics: Dict[str, object] = {}
 
@@ -170,6 +177,16 @@ class LeafExec(PhysicalPlan):
     #: True when the executor must load and pass a Batch argument
     needs_input = False
 
+    #: mesh data-axis size the planner targeted (1 = single chip). When
+    #: >1, the leaf's rows are sharded over the axis, so its output
+    #: partitioning is unknown and exchanges get inserted above it.
+    dist_n: int = 1
+
+    def output_partitioning(self):
+        if self.dist_n > 1:
+            return UnknownPartitioning(self.dist_n)
+        return SinglePartition()
+
     def load(self):  # host side
         raise NotImplementedError
 
@@ -188,6 +205,16 @@ class RangeExec(LeafExec):
     def compute(self, ctx, inputs):
         n = self.num_rows()
         cap = bucket_capacity(n)
+        if ctx.axis_name is not None:
+            # synthesize only this shard's contiguous stripe
+            shards = ctx.n_shards
+            cap += (-cap) % shards
+            local = cap // shards
+            i = jax.lax.axis_index(ctx.axis_name)
+            base = i.astype(jnp.int64) * local
+            offs = base + jnp.arange(local, dtype=jnp.int64)
+            ids = self.start + self.step * offs
+            return Batch({"id": Column(ids, T.LONG)}, offs < n)
         ids = self.start + self.step * jnp.arange(cap, dtype=jnp.int64)
         sel = jnp.arange(cap) < n
         return Batch({"id": Column(ids, T.LONG)}, sel)
@@ -501,6 +528,12 @@ class HashAggregateExec(UnaryExec):
         return Batch(cols, occupied)
 
     def output_partitioning(self):
+        if self.mode == "partial":
+            # per-shard accumulator tables: rows for one key exist on
+            # every shard, so nothing stronger than the child's layout
+            # (claiming SinglePartition here would suppress the exchange
+            # the final aggregate depends on)
+            return self.child.output_partitioning()
         if not self.group_exprs:
             return SinglePartition()
         return self.child.output_partitioning()
@@ -600,7 +633,7 @@ class JoinExec(PhysicalPlan):
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  how: str, condition: Optional[Expression],
                  out_schema: T.Schema, out_cap: Optional[int] = None,
-                 tag: str = "j0"):
+                 tag: str = "j0", strategy: str = "shuffle"):
         self.children = (left, right)
         self.left_keys = tuple(left_keys)
         self.right_keys = tuple(right_keys)
@@ -609,6 +642,12 @@ class JoinExec(PhysicalPlan):
         self._schema = out_schema
         self.out_cap = out_cap
         self.tag = tag
+        # "shuffle": co-partition both sides (ShuffledHashJoinExec.scala:37
+        # analog); "broadcast": replicate the small build side via
+        # all_gather and leave the probe side in place
+        # (BroadcastHashJoinExec.scala:40 analog). Picked by the planner
+        # from source row estimates vs autoBroadcastJoinThreshold.
+        self.strategy = strategy
 
     @property
     def left(self):
@@ -621,12 +660,39 @@ class JoinExec(PhysicalPlan):
     def schema(self):
         return self._schema
 
+    def _clusterable_key_names(self):
+        """Key positions usable for hash partitioning: both sides must be
+        plain column references (the exchange hashes child columns by
+        name; a computed key has no column to hash)."""
+        from ..expr import ColumnRef
+        lk, rk = [], []
+        for l, r in zip(self.left_keys, self.right_keys):
+            le, re = l, r
+            while isinstance(le, Alias):
+                le = le.child
+            while isinstance(re, Alias):
+                re = re.child
+            if isinstance(le, ColumnRef) and isinstance(re, ColumnRef):
+                lk.append(le.name())
+                rk.append(re.name())
+        return tuple(lk), tuple(rk)
+
     def required_child_distributions(self):
-        lk = tuple(k.name() for k in self.left_keys)
-        rk = tuple(k.name() for k in self.right_keys)
+        if self.strategy == "broadcast":
+            return [UnspecifiedDistribution(), BroadcastDistribution()]
+        lk, rk = self._clusterable_key_names()
+        if not lk:
+            # no hashable key columns (e.g. cross join's literal keys):
+            # every probe row must see every build row -> replicate build
+            return [UnspecifiedDistribution(), BroadcastDistribution()]
         return [ClusteredDistribution(lk), ClusteredDistribution(rk)]
 
     def output_partitioning(self):
+        if self.how in ("right", "full"):
+            # appended null-extended rows carry NULL left keys on whatever
+            # shard held the unmatched build row — no layout guarantee
+            # (the reference returns UnknownPartitioning here too)
+            return UnknownPartitioning()
         return self.left.output_partitioning()
 
     def _eval_keys(self, probe_batch, build_batch):
@@ -789,7 +855,8 @@ class JoinExec(PhysicalPlan):
     def simple_string(self):
         return (f"JoinExec({self.how}, {[repr(k) for k in self.left_keys]} = "
                 f"{[repr(k) for k in self.right_keys]}, "
-                f"cond={self.condition!r}, cap={self.out_cap})")
+                f"cond={self.condition!r}, cap={self.out_cap}, "
+                f"strategy={self.strategy})")
 
 
 def _unify_key_dictionaries(lvecs: List[Vec], rvecs: List[Vec]
@@ -910,9 +977,13 @@ def _pack_key_pair(lvecs: List[Vec], rvecs: List[Vec]
 
 
 class ExchangeExec(UnaryExec):
-    """Repartitioning boundary (reference: ShuffleExchangeExec.scala:115).
-    On a single chip this is a logical no-op; on a mesh it lowers to
-    radix-partition + all_to_all (execution/shuffle.py)."""
+    """Repartitioning boundary (reference: ShuffleExchangeExec.scala:115
+    for the hash case, BroadcastExchangeExec.scala:78 for Replicated).
+
+    On a single chip this is the identity; inside a `shard_map` over the
+    mesh it lowers to collectives (parallel/shuffle.py):
+      HashPartitioning           -> radix-partition + all_to_all
+      SinglePartition/Replicated -> all_gather"""
 
     def __init__(self, child: PhysicalPlan, partitioning: Partitioning):
         self.children = (child,)
@@ -925,7 +996,16 @@ class ExchangeExec(UnaryExec):
         return self.partitioning
 
     def compute(self, ctx, inputs):
-        return inputs[0]
+        if ctx.axis_name is None or ctx.n_shards <= 1:
+            return inputs[0]
+        from ..parallel import shuffle
+        if isinstance(self.partitioning, HashPartitioning):
+            return shuffle.exchange_hash(inputs[0], self.partitioning.keys,
+                                         ctx)
+        if isinstance(self.partitioning, (SinglePartition, Replicated)):
+            return shuffle.all_gather_batch(inputs[0], ctx)
+        raise AnalysisError(
+            f"no collective lowering for {self.partitioning!r}")
 
     def simple_string(self):
         return f"ExchangeExec({self.partitioning!r})"

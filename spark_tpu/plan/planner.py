@@ -4,9 +4,15 @@ The analog of the reference's `SparkPlanner.scala:28` strategies +
 `EnsureRequirements.scala:44`: translate each logical node into an
 executable operator, then walk the tree inserting Exchange nodes wherever
 a child's output partitioning does not satisfy the operator's required
-distribution. On one chip everything is SinglePartition and no exchange
-materializes; the distributed planner (parallel/) re-plans aggregates as
-partial/final across a hash exchange the way `AggUtils.scala` does.
+distribution.
+
+Distributed mode (mesh.size > 1) additionally:
+- shards leaves over the mesh data axis (their partitioning becomes
+  Unknown, which forces exchanges);
+- splits aggregates into partial -> exchange -> final, the
+  `AggUtils.scala` two-phase plan, so only accumulator tables cross ICI;
+- picks broadcast vs shuffle joins from source row estimates against
+  `autoBroadcastJoinThreshold` (`SparkStrategies.scala JoinSelection:142`).
 """
 
 from __future__ import annotations
@@ -14,14 +20,15 @@ from __future__ import annotations
 from typing import Optional
 
 from ..config import Conf
-from ..expr import AnalysisError
+from ..expr import AnalysisError, ColumnRef
 from . import logical as L
 from . import physical as P
 
 
 def plan_physical(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
-    phys = _convert(plan, conf)
-    phys = ensure_requirements(phys, conf)
+    n = max(1, int(conf.get("spark_tpu.sql.mesh.size")))
+    phys = _convert(plan, conf, n)
+    phys = ensure_requirements(phys, conf, n)
     _assign_join_tags(phys)
     return phys
 
@@ -41,52 +48,145 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
     walk(plan)
 
 
-def _convert(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
+def estimate_rows(plan: L.LogicalPlan) -> Optional[int]:
+    """Upper-bound row estimate from source statistics (the planner-side
+    sliver of the reference's statsEstimation/ package). None = unknown."""
     if isinstance(plan, L.Range):
-        return P.RangeExec(plan.start, plan.end, plan.step)
+        return plan.num_rows()
     if isinstance(plan, L.Scan):
-        return P.ScanExec(plan.source, plan.required_columns, plan.pushed_filters)
-    if isinstance(plan, L.Project):
-        return P.ProjectExec(_convert(plan.child, conf), plan.exprs)
-    if isinstance(plan, L.Filter):
-        return P.FilterExec(_convert(plan.child, conf), plan.condition)
-    if isinstance(plan, L.Aggregate):
-        return P.HashAggregateExec(_convert(plan.child, conf),
-                                   plan.group_exprs, plan.agg_exprs,
-                                   mode="complete")
-    if isinstance(plan, L.Join):
-        return P.JoinExec(_convert(plan.left, conf), _convert(plan.right, conf),
-                          plan.left_keys, plan.right_keys, plan.how,
-                          plan.condition, plan.schema())
-    if isinstance(plan, L.Sort):
-        return P.SortExec(_convert(plan.child, conf), plan.orders)
+        return plan.source.estimated_rows()
+    if isinstance(plan, (L.Project, L.Filter, L.Sort)):
+        return estimate_rows(plan.children[0])
     if isinstance(plan, L.Limit):
-        return P.LimitExec(_convert(plan.child, conf), plan.n)
+        child = estimate_rows(plan.children[0])
+        return plan.n if child is None else min(plan.n, child)
+    if isinstance(plan, L.Aggregate):
+        return estimate_rows(plan.children[0])
+    return None
+
+
+def _estimated_bytes(plan: L.LogicalPlan) -> Optional[int]:
+    rows = estimate_rows(plan)
+    if rows is None:
+        return None
+    return rows * 8 * max(1, len(plan.schema().fields))
+
+
+def _pick_join_strategy(plan: L.Join, conf: Conf, n: int) -> str:
+    if n <= 1:
+        return "shuffle"  # strategies coincide on one chip
+    if plan.how in ("right", "full"):
+        # replicated build would emit its unmatched rows on every shard
+        return "shuffle"
+    threshold = int(conf.get("spark_tpu.sql.autoBroadcastJoinThreshold"))
+    est = _estimated_bytes(plan.right)
+    if est is not None and est <= threshold:
+        return "broadcast"
+    return "shuffle"
+
+
+def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
+    if isinstance(plan, L.Range):
+        node = P.RangeExec(plan.start, plan.end, plan.step)
+        node.dist_n = n
+        return node
+    if isinstance(plan, L.Scan):
+        node = P.ScanExec(plan.source, plan.required_columns,
+                          plan.pushed_filters)
+        node.dist_n = n
+        return node
+    if isinstance(plan, L.Project):
+        return P.ProjectExec(_convert(plan.child, conf, n), plan.exprs)
+    if isinstance(plan, L.Filter):
+        return P.FilterExec(_convert(plan.child, conf, n), plan.condition)
+    if isinstance(plan, L.Aggregate):
+        child = _convert(plan.child, conf, n)
+        if n <= 1:
+            return P.HashAggregateExec(child, plan.group_exprs,
+                                       plan.agg_exprs, mode="complete")
+        # two-phase: per-shard partial tables, exchange by group key (or
+        # collapse to every shard for global aggregates), final re-reduce
+        partial = P.HashAggregateExec(child, plan.group_exprs,
+                                      plan.agg_exprs, mode="partial")
+        final_groups = [ColumnRef(g.name()) for g in plan.group_exprs]
+        return P.HashAggregateExec(partial, final_groups, plan.agg_exprs,
+                                   mode="final")
+    if isinstance(plan, L.Join):
+        strategy = _pick_join_strategy(plan, conf, n)
+        return P.JoinExec(_convert(plan.left, conf, n),
+                          _convert(plan.right, conf, n),
+                          plan.left_keys, plan.right_keys, plan.how,
+                          plan.condition, plan.schema(), strategy=strategy)
+    if isinstance(plan, L.Sort):
+        return P.SortExec(_convert(plan.child, conf, n), plan.orders)
+    if isinstance(plan, L.Limit):
+        return P.LimitExec(_convert(plan.child, conf, n), plan.n)
     if isinstance(plan, L.Union):
-        return P.UnionExec(_convert(plan.children[0], conf),
-                           _convert(plan.children[1], conf), plan.schema())
+        return P.UnionExec(_convert(plan.children[0], conf, n),
+                           _convert(plan.children[1], conf, n), plan.schema())
     raise AnalysisError(f"no physical strategy for {type(plan).__name__}")
 
 
-def ensure_requirements(plan: P.PhysicalPlan, conf: Conf) -> P.PhysicalPlan:
+def _join_co_partitioned(left: P.PhysicalPlan, right: P.PhysicalPlan,
+                         lk, rk) -> bool:
+    """True when both join children are ALREADY laid out so equal keys
+    share a shard. Checked jointly — each side satisfying its clustered
+    requirement in isolation is NOT enough: hash layouts on different key
+    subsets (or subset positions) send equal rows to different shards
+    (reference: EnsureRequirements' key-ordering co-partition check)."""
+    lp = left.output_partitioning()
+    rp = right.output_partitioning()
+    if isinstance(lp, P.SinglePartition) and isinstance(rp, P.SinglePartition):
+        return True
+    if not (isinstance(lp, P.HashPartitioning)
+            and isinstance(rp, P.HashPartitioning)):
+        return False
+    if lp.num_partitions != rp.num_partitions or not lp.keys:
+        return False
+    try:
+        lpos = [lk.index(k) for k in lp.keys]
+        rpos = [rk.index(k) for k in rp.keys]
+    except ValueError:
+        return False
+    return lpos == rpos
+
+
+def ensure_requirements(plan: P.PhysicalPlan, conf: Conf,
+                        n: int = 1) -> P.PhysicalPlan:
     """Insert exchanges where child partitioning fails the requirement
     (reference: EnsureRequirements.ensureDistributionAndOrdering:49)."""
-    new_children = tuple(ensure_requirements(c, conf) for c in plan.children)
+    import copy
+    new_children = tuple(ensure_requirements(c, conf, n)
+                         for c in plan.children)
     if new_children != plan.children:
-        import copy
         plan = copy.copy(plan)
         plan.children = new_children
+
+    dists = plan.required_child_distributions()
+    parts = n if n > 1 else int(conf.get("spark_tpu.sql.shuffle.partitions"))
+
+    if isinstance(plan, P.JoinExec) and dists and \
+            isinstance(dists[0], P.ClusteredDistribution):
+        lk, rk = dists[0].keys, dists[1].keys
+        if not _join_co_partitioned(plan.left, plan.right, list(lk), list(rk)):
+            plan = copy.copy(plan)
+            plan.children = (
+                P.ExchangeExec(plan.children[0],
+                               P.HashPartitioning(lk, parts)),
+                P.ExchangeExec(plan.children[1],
+                               P.HashPartitioning(rk, parts)))
+        return plan
+
     fixed = []
     changed = False
-    for child, dist in zip(plan.children, plan.required_child_distributions()):
+    for child, dist in zip(plan.children, dists):
         if child.output_partitioning().satisfies(dist):
             fixed.append(child)
             continue
         changed = True
         if isinstance(dist, P.ClusteredDistribution):
-            n = int(conf.get("spark_tpu.sql.shuffle.partitions"))
             fixed.append(P.ExchangeExec(
-                child, P.HashPartitioning(dist.keys, n)))
+                child, P.HashPartitioning(dist.keys, parts)))
         elif isinstance(dist, P.AllTuples):
             fixed.append(P.ExchangeExec(child, P.SinglePartition()))
         elif isinstance(dist, P.BroadcastDistribution):
@@ -94,7 +194,6 @@ def ensure_requirements(plan: P.PhysicalPlan, conf: Conf) -> P.PhysicalPlan:
         else:
             fixed.append(child)
     if changed:
-        import copy
         plan = copy.copy(plan)
         plan.children = tuple(fixed)
     return plan
