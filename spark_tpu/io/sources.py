@@ -25,7 +25,23 @@ from ..expr import (And, BinaryComparison, ColumnRef, EQ, Expression, GE, GT,
                     In, IsNull, LE, LT, Literal, NE, Not, Or)
 
 
-def expr_to_arrow(e: Expression):
+def _decimal_literal_scalar(col_field: pa.Field, value):
+    """Coerce a numeric literal to the column's decimal type for a
+    pushed comparison — pyarrow cannot compare decimal to float64.
+    Returns None when the value is not exactly representable at the
+    column's scale (the conjunct then stays residual-only, where the
+    device compares in float and is exact)."""
+    import decimal as D
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return None
+    d = D.Decimal(str(value))
+    q = d.quantize(D.Decimal(1).scaleb(-col_field.type.scale))
+    if q != d:
+        return None  # rounding would change the predicate
+    return pa.scalar(q, type=col_field.type)
+
+
+def expr_to_arrow(e: Expression, schema: Optional[pa.Schema] = None):
     """Convert a pushable predicate to a pyarrow.dataset expression.
     Returns None when not convertible (the conjunct stays residual)."""
     if isinstance(e, ColumnRef):
@@ -37,28 +53,44 @@ def expr_to_arrow(e: Expression):
             v = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
         return pa.scalar(v) if not isinstance(v, Expression) else None
     if isinstance(e, BinaryComparison):
-        l = expr_to_arrow(e.children[0])
-        r = expr_to_arrow(e.children[1])
+        le, re = e.children
+        l = expr_to_arrow(le, schema)
+        r = expr_to_arrow(re, schema)
         if l is None or r is None:
             return None
+        # decimal column vs numeric literal: coerce the literal
+        if schema is not None:
+            for col_e, is_left in ((le, True), (re, False)):
+                lit_e = re if is_left else le
+                if isinstance(col_e, ColumnRef) and isinstance(lit_e, Literal):
+                    idx = schema.get_field_index(col_e._name)
+                    if idx >= 0 and pa.types.is_decimal(schema.field(idx).type):
+                        s = _decimal_literal_scalar(schema.field(idx),
+                                                    lit_e.value)
+                        if s is None:
+                            return None
+                        if is_left:
+                            r = s
+                        else:
+                            l = s
         ops = {EQ: lambda a, b: a == b, NE: lambda a, b: a != b,
                LT: lambda a, b: a < b, LE: lambda a, b: a <= b,
                GT: lambda a, b: a > b, GE: lambda a, b: a >= b}
         return ops[type(e)](l, r)
     if isinstance(e, And):
-        l, r = (expr_to_arrow(c) for c in e.children)
+        l, r = (expr_to_arrow(c, schema) for c in e.children)
         return None if l is None or r is None else l & r
     if isinstance(e, Or):
-        l, r = (expr_to_arrow(c) for c in e.children)
+        l, r = (expr_to_arrow(c, schema) for c in e.children)
         return None if l is None or r is None else l | r
     if isinstance(e, Not):
-        c = expr_to_arrow(e.children[0])
+        c = expr_to_arrow(e.children[0], schema)
         return None if c is None else ~c
     if isinstance(e, In):
-        c = expr_to_arrow(e.children[0])
+        c = expr_to_arrow(e.children[0], schema)
         return None if c is None else c.isin(list(e.values))
     if isinstance(e, IsNull):
-        c = expr_to_arrow(e.children[0])
+        c = expr_to_arrow(e.children[0], schema)
         return None if c is None else c.is_null()
     return None
 
@@ -194,7 +226,7 @@ class ArrowTableSource(TableSource):
         return _arrow_schema_to_engine(self.table.schema)
 
     def can_push(self, e: Expression) -> bool:
-        return expr_to_arrow(e) is not None
+        return expr_to_arrow(e, self.table.schema) is not None
 
     def estimated_rows(self):
         return self.table.num_rows
@@ -202,7 +234,7 @@ class ArrowTableSource(TableSource):
     def load(self, required_columns, pushed_filters) -> Batch:
         t = self.table
         for f in pushed_filters:
-            ae = expr_to_arrow(f)
+            ae = expr_to_arrow(f, self.table.schema)
             if ae is not None:
                 t = t.filter(ae)
         if required_columns is not None:
@@ -213,7 +245,7 @@ class ArrowTableSource(TableSource):
                     chunk_rows: int) -> ChunkIterator:
         t = self.table
         for f in pushed_filters:
-            ae = expr_to_arrow(f)
+            ae = expr_to_arrow(f, self.table.schema)
             if ae is not None:
                 t = t.filter(ae)
         if required_columns is not None:
@@ -235,7 +267,7 @@ class ParquetSource(TableSource):
         return _arrow_schema_to_engine(self._dataset.schema)
 
     def can_push(self, e: Expression) -> bool:
-        return expr_to_arrow(e) is not None
+        return expr_to_arrow(e, self._dataset.schema) is not None
 
     def estimated_rows(self):
         try:
@@ -246,7 +278,7 @@ class ParquetSource(TableSource):
     def load(self, required_columns, pushed_filters) -> Batch:
         ae = None
         for f in pushed_filters:
-            e = expr_to_arrow(f)
+            e = expr_to_arrow(f, self._dataset.schema)
             if e is not None:
                 ae = e if ae is None else (ae & e)
         t = self._dataset.to_table(
@@ -258,7 +290,7 @@ class ParquetSource(TableSource):
                     chunk_rows: int) -> ChunkIterator:
         ae = None
         for f in pushed_filters:
-            e = expr_to_arrow(f)
+            e = expr_to_arrow(f, self._dataset.schema)
             if e is not None:
                 ae = e if ae is None else (ae & e)
         scanner = self._dataset.scanner(

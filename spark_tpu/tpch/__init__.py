@@ -1,0 +1,14 @@
+"""TPC-H harness: data generation, the north-star queries, and pandas
+golden references for result-parity checks.
+
+The reference repo commits TPC-DS benchmark results only
+(`sql/core/benchmarks/TPCDSQueryBenchmark-results.txt`); BASELINE.md
+directs that the TPC-H harness be written fresh, modeled on
+`TPCDSQueryBenchmark.scala:54` (timed queries over generated Parquet) and
+`SQLQueryTestSuite.scala:124` (golden-answer comparison).
+"""
+
+from .datagen import generate, write_parquet
+from .queries import QUERIES, register_tables
+
+__all__ = ["generate", "write_parquet", "QUERIES", "register_tables"]

@@ -1,0 +1,127 @@
+"""Independent pandas implementations of the north-star queries.
+
+These compute golden answers on the generated data (the trusted-engine
+role duckdb/real-Spark would play; pandas is the independent engine baked
+into this image). Parity checks compare engine output against these with
+a small float tolerance — the `QueryTest.checkAnswer` pattern.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow.parquet as pq
+
+
+def _read(path: str, name: str) -> pd.DataFrame:
+    df = pq.read_table(os.path.join(path, f"{name}.parquet")).to_pandas()
+    for c in df.columns:
+        # decimals -> float for the pandas reference arithmetic
+        if df[c].dtype == object and len(df) and \
+                df[c].iloc[0].__class__.__name__ == "Decimal":
+            df[c] = df[c].astype(float)
+    return df
+
+
+def q1(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    l = l[l["l_shipdate"] <= pd.Timestamp("1998-09-02").date()]
+    l = l.assign(
+        disc_price=l["l_extendedprice"] * (1 - l["l_discount"]),
+        charge=l["l_extendedprice"] * (1 - l["l_discount"])
+        * (1 + l["l_tax"]))
+    out = (l.groupby(["l_returnflag", "l_linestatus"], as_index=False)
+           .agg(sum_qty=("l_quantity", "sum"),
+                sum_base_price=("l_extendedprice", "sum"),
+                sum_disc_price=("disc_price", "sum"),
+                sum_charge=("charge", "sum"),
+                avg_qty=("l_quantity", "mean"),
+                avg_price=("l_extendedprice", "mean"),
+                avg_disc=("l_discount", "mean"),
+                count_order=("l_quantity", "size")))
+    return out.sort_values(["l_returnflag", "l_linestatus"]) \
+        .reset_index(drop=True)
+
+
+def q3(path: str) -> pd.DataFrame:
+    c = _read(path, "customer")
+    o = _read(path, "orders")
+    l = _read(path, "lineitem")
+    c = c[c["c_mktsegment"] == "BUILDING"]
+    o = o[o["o_orderdate"] < pd.Timestamp("1995-03-15").date()]
+    l = l[l["l_shipdate"] > pd.Timestamp("1995-03-15").date()]
+    m = l.merge(o, left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+    m = m.assign(revenue=m["l_extendedprice"] * (1 - m["l_discount"]))
+    out = (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                     as_index=False).agg(revenue=("revenue", "sum")))
+    out = out.sort_values(["revenue", "o_orderdate"],
+                          ascending=[False, True]).head(10)
+    return out[["l_orderkey", "o_orderdate", "o_shippriority", "revenue"]] \
+        .reset_index(drop=True)
+
+
+def q5(path: str) -> pd.DataFrame:
+    r = _read(path, "region")
+    n = _read(path, "nation")
+    c = _read(path, "customer")
+    o = _read(path, "orders")
+    l = _read(path, "lineitem")
+    s = _read(path, "supplier")
+    r = r[r["r_name"] == "ASIA"]
+    m = (c.merge(n, left_on="c_nationkey", right_on="n_nationkey")
+         .merge(r, left_on="n_regionkey", right_on="r_regionkey"))
+    o = o[(o["o_orderdate"] >= pd.Timestamp("1994-01-01").date())
+          & (o["o_orderdate"] < pd.Timestamp("1995-01-01").date())]
+    m = o.merge(m, left_on="o_custkey", right_on="c_custkey")
+    m = l.merge(m, left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    m = m[m["c_nationkey"] == m["s_nationkey"]]
+    m = m.assign(revenue=m["l_extendedprice"] * (1 - m["l_discount"]))
+    out = (m.groupby("n_name", as_index=False).agg(revenue=("revenue", "sum"))
+           .sort_values("revenue", ascending=False))
+    return out.reset_index(drop=True)
+
+
+def q6(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    m = l[(l["l_shipdate"] >= pd.Timestamp("1994-01-01").date())
+          & (l["l_shipdate"] < pd.Timestamp("1995-01-01").date())
+          & (l["l_discount"] >= 0.05 - 1e-9)
+          & (l["l_discount"] <= 0.07 + 1e-9)
+          & (l["l_quantity"] < 24)]
+    return pd.DataFrame(
+        {"revenue": [(m["l_extendedprice"] * m["l_discount"]).sum()]})
+
+
+GOLDEN = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
+
+
+def compare(got: pd.DataFrame, want: pd.DataFrame,
+            float_rtol: float = 1e-6, float_atol: float = 1e-6) -> None:
+    """Row-set comparison with float tolerance (QueryTest.checkAnswer).
+    `float_atol` absorbs legitimate decimal-scale rounding: avg(decimal)
+    rounds HALF_UP at result scale 6 per the reference, pandas does not."""
+    if len(got) != len(want):
+        raise AssertionError(
+            f"row count {len(got)} != {len(want)}\n{got}\n{want}")
+    if list(got.columns) != list(want.columns):
+        raise AssertionError(f"columns {list(got.columns)} != "
+                             f"{list(want.columns)}")
+    for c in want.columns:
+        g, w = got[c], want[c]
+        try:
+            gf = g.astype(float)
+            wf = w.astype(float)
+            if not np.allclose(gf, wf, rtol=float_rtol, atol=float_atol, equal_nan=True):
+                bad = np.nonzero(~np.isclose(gf, wf, rtol=float_rtol,
+                                             atol=float_atol, equal_nan=True))[0]
+                raise AssertionError(
+                    f"column {c} diverges at rows {bad[:5]}:\n"
+                    f"got {gf.iloc[bad[:5]].tolist()}\n"
+                    f"want {wf.iloc[bad[:5]].tolist()}")
+        except (ValueError, TypeError):
+            if list(g.astype(str)) != list(w.astype(str)):
+                raise AssertionError(f"column {c} diverges:\n{g}\n{w}")

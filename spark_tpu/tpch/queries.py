@@ -1,0 +1,99 @@
+"""The TPC-H north-star queries as DataFrame programs (BASELINE.md
+progression: Q1 -> Q6 -> Q3 -> Q5).
+
+Join orders put the big table on the probe (left) side so the expansion
+join's default output capacity (probe capacity) is exact for the FK
+shapes, and dimension tables land on the build side where the planner
+can pick the broadcast (all_gather) strategy on a mesh.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import functions as F
+from ..functions import col, lit, to_date
+from ..io.sources import ParquetSource
+
+
+def register_tables(session, path: str) -> None:
+    """Point the session catalog at the generated Parquet directory."""
+    for name in ("lineitem", "orders", "customer", "supplier", "nation",
+                 "region", "part", "partsupp"):
+        p = os.path.join(path, f"{name}.parquet")
+        if os.path.exists(p):
+            session.register_table(name, ParquetSource(p, name))
+
+
+def q1(session):
+    """Pricing summary report (TPC-H Q1)."""
+    l = session.table("lineitem")
+    disc_price = col("l_extendedprice") * (lit(1) - col("l_discount"))
+    charge = disc_price * (lit(1) + col("l_tax"))
+    return (l.filter(col("l_shipdate") <= to_date("1998-09-02"))
+            .group_by(col("l_returnflag"), col("l_linestatus"))
+            .agg(F.sum(col("l_quantity")).alias("sum_qty"),
+                 F.sum(col("l_extendedprice")).alias("sum_base_price"),
+                 F.sum(disc_price).alias("sum_disc_price"),
+                 F.sum(charge).alias("sum_charge"),
+                 F.avg(col("l_quantity")).alias("avg_qty"),
+                 F.avg(col("l_extendedprice")).alias("avg_price"),
+                 F.avg(col("l_discount")).alias("avg_disc"),
+                 F.count().alias("count_order"))
+            .sort(col("l_returnflag").asc(), col("l_linestatus").asc()))
+
+
+def q3(session):
+    """Shipping priority (TPC-H Q3): 3-way join + top-10."""
+    c = session.table("customer").filter(
+        col("c_mktsegment") == lit("BUILDING"))
+    o = (session.table("orders")
+         .filter(col("o_orderdate") < to_date("1995-03-15"))
+         .join(c, left_on=col("o_custkey"), right_on=col("c_custkey")))
+    l = (session.table("lineitem")
+         .filter(col("l_shipdate") > to_date("1995-03-15"))
+         .join(o, left_on=col("l_orderkey"), right_on=col("o_orderkey")))
+    revenue = col("l_extendedprice") * (lit(1) - col("l_discount"))
+    return (l.group_by(col("l_orderkey"), col("o_orderdate"),
+                       col("o_shippriority"))
+            .agg(F.sum(revenue).alias("revenue"))
+            .sort(col("revenue").desc(), col("o_orderdate").asc())
+            .limit(10))
+
+
+def q5(session):
+    """Local supplier volume (TPC-H Q5): 6-way join over ASIA."""
+    r = session.table("region").filter(col("r_name") == lit("ASIA"))
+    n = session.table("nation").join(
+        r, left_on=col("n_regionkey"), right_on=col("r_regionkey"))
+    c = session.table("customer").join(
+        n, left_on=col("c_nationkey"), right_on=col("n_nationkey"))
+    o = (session.table("orders")
+         .filter((col("o_orderdate") >= to_date("1994-01-01"))
+                 & (col("o_orderdate") < to_date("1995-01-01")))
+         .join(c, left_on=col("o_custkey"), right_on=col("c_custkey")))
+    l = session.table("lineitem").join(
+        o, left_on=col("l_orderkey"), right_on=col("o_orderkey"))
+    # supplier must sit in the customer's nation (the Q5 twist)
+    ls = l.join(session.table("supplier"),
+                left_on=col("l_suppkey"), right_on=col("s_suppkey"),
+                condition=col("c_nationkey") == col("s_nationkey"))
+    revenue = col("l_extendedprice") * (lit(1) - col("l_discount"))
+    return (ls.group_by(col("n_name"))
+            .agg(F.sum(revenue).alias("revenue"))
+            .sort(col("revenue").desc()))
+
+
+def q6(session):
+    """Forecasting revenue change (TPC-H Q6): predicate-heavy scan + SUM."""
+    l = session.table("lineitem")
+    return (l.filter((col("l_shipdate") >= to_date("1994-01-01"))
+                     & (col("l_shipdate") < to_date("1995-01-01"))
+                     & (col("l_discount") >= lit(0.05))
+                     & (col("l_discount") <= lit(0.07))
+                     & (col("l_quantity") < lit(24)))
+            .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6}
