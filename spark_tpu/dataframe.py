@@ -140,6 +140,29 @@ class DataFrame:
 
     unionAll = union
 
+    def distinct(self) -> "DataFrame":
+        """Deduplicate rows: an aggregate grouping on every column with no
+        aggregate functions (reference: Dataset.distinct -> Deduplicate ->
+        Aggregate rewrite)."""
+        cols = [ColumnRef(n) for n in self.plan.schema().names]
+        return self._with(L.Aggregate(self.plan, cols, []))
+
+    def drop_duplicates(self, subset: Optional[Sequence[str]] = None
+                        ) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        missing = [n for n in subset if n not in self.plan.schema().names]
+        if missing:
+            raise AnalysisError(f"dropDuplicates: unknown columns {missing}")
+        if set(subset) == set(self.plan.schema().names):
+            return self.distinct()
+        raise AnalysisError(
+            "dropDuplicates on a column subset needs first()-style "
+            "aggregates (not supported yet); use distinct() or aggregate "
+            "explicitly")
+
+    dropDuplicates = drop_duplicates
+
     # -- metadata -----------------------------------------------------------
 
     @property

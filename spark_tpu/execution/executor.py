@@ -150,8 +150,8 @@ class QueryExecution:
                     for k, v in ctx.flags.items()}
                 metrics = {}
                 for k, v in ctx.metrics.items():
-                    red = jax.lax.pmax if k.startswith("join_rows_") \
-                        else jax.lax.psum
+                    red = jax.lax.pmax if k.startswith(
+                        ("join_rows_", "exch_max_")) else jax.lax.psum
                     metrics[k] = red(jnp.asarray(v), AXIS)
                 return out, flags, metrics
 
@@ -169,6 +169,13 @@ class QueryExecution:
             QueryExecution._set_join_cap(c, tag, cap)
         if isinstance(root, P.JoinExec) and root.tag == tag:
             root.out_cap = cap
+
+    @staticmethod
+    def _set_exchange_cap(root: P.PhysicalPlan, tag: str, cap: int) -> None:
+        for c in root.children:
+            QueryExecution._set_exchange_cap(c, tag, cap)
+        if isinstance(root, P.ExchangeExec) and root.tag == tag:
+            root.block_cap = cap
 
     def execute_batch(self) -> Tuple[Batch, Dict, Dict]:
         """Run the query, returning (device Batch, flags, metrics).
@@ -209,15 +216,21 @@ class QueryExecution:
             else:
                 batch, flags, metrics = fn(scan_batches, token)
             overflow = [k for k, v in flags.items()
-                        if k.startswith("join_overflow_")
+                        if k.startswith(("join_overflow_", "exch_overflow_"))
                         and bool(np.asarray(v))]
             if not overflow:
                 break
             for k in overflow:
-                tag = k[len("join_overflow_"):]
-                total = int(np.asarray(metrics[f"join_rows_{tag}"]))
-                self._set_join_cap(root, tag,
-                                   bucket_capacity(max(total, 8)))
+                if k.startswith("join_overflow_"):
+                    tag = k[len("join_overflow_"):]
+                    total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                    self._set_join_cap(root, tag,
+                                       bucket_capacity(max(total, 8)))
+                else:
+                    tag = k[len("exch_overflow_"):]
+                    mx = int(np.asarray(metrics[f"exch_max_{tag}"]))
+                    self._set_exchange_cap(root, tag,
+                                           bucket_capacity(max(mx, 8)))
         else:
             raise RuntimeError("join output capacity did not converge")
         batch = jax.block_until_ready(batch)

@@ -655,8 +655,26 @@ class Neg(Expression):
         return f"(-{self.children[0]!r})"
 
 
-class ExtractYear(Expression):
-    """year(date) — days-since-epoch -> calendar year, branch-free."""
+def _civil_from_days(days):
+    """days-since-epoch -> (year, month, day), branch-free (Howard
+    Hinnant's civil-from-days algorithm, vectorized)."""
+    z = days + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    year = jnp.where(m <= 2, y + 1, y)
+    return year, m, d
+
+
+class _ExtractDatePart(Expression):
+    """year/month/day(date) (reference: datetimeExpressions.scala)."""
+
+    _part = "year"
 
     def __init__(self, child):
         self.children = (child,)
@@ -666,21 +684,51 @@ class ExtractYear(Expression):
 
     def eval(self, batch):
         v = self.children[0].eval(batch)
-        days = v.data.astype(jnp.int64)
-        # civil-from-days (Howard Hinnant's algorithm), vectorized
-        z = days + 719468
-        era = jnp.where(z >= 0, z, z - 146096) // 146097
-        doe = z - era * 146097
-        yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
-        y = yoe + era * 400
-        doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-        mp = (5 * doy + 2) // 153
-        m = jnp.where(mp < 10, mp + 3, mp - 9)
-        year = jnp.where(m <= 2, y + 1, y)
-        return Vec(year.astype(jnp.int32), T.INT, v.validity)
+        x = v.data.astype(jnp.int64)
+        if isinstance(v.dtype, T.TimestampType):
+            # microseconds -> days (// floors, so pre-epoch is correct)
+            x = x // jnp.int64(86_400_000_000)
+        y, m, d = _civil_from_days(x)
+        part = {"year": y, "month": m, "day": d}[self._part]
+        return Vec(part.astype(jnp.int32), T.INT, v.validity)
 
     def __repr__(self):
-        return f"year({self.children[0]!r})"
+        return f"{self._part}({self.children[0]!r})"
+
+
+class ExtractYear(_ExtractDatePart):
+    _part = "year"
+
+
+class ExtractMonth(_ExtractDatePart):
+    _part = "month"
+
+
+class ExtractDay(_ExtractDatePart):
+    _part = "day"
+
+
+class DateAdd(Expression):
+    """date_add(date, n): shift by days (reference: DateAdd)."""
+
+    def __init__(self, child, days: Expression):
+        self.children = (child, days)
+
+    def dtype(self, schema):
+        return T.DATE
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        n = self.children[1].eval(batch)
+        x = v.data
+        if isinstance(v.dtype, T.TimestampType):
+            # like the reference, the timestamp is cast to DATE first
+            x = x.astype(jnp.int64) // jnp.int64(86_400_000_000)
+        data = (x.astype(jnp.int32) + n.data.astype(jnp.int32))
+        return Vec(data, T.DATE, _and_valid(v.validity, n.validity))
+
+    def __repr__(self):
+        return f"date_add({self.children[0]!r}, {self.children[1]!r})"
 
 
 # ---------------------------------------------------------------------------
@@ -702,6 +750,24 @@ class BinaryComparison(Expression):
         # dictionary-encoded string vs host string literal
         if isinstance(lv.dtype, T.StringType) or isinstance(rv.dtype, T.StringType):
             return self._eval_string(lv, rv, batch)
+        # decimal column vs float scalar: comparing through f64 is exact on
+        # CPU but NOT on TPU (f64 is emulated at <53-bit precision there:
+        # 5/100.0 evaluates below 0.05, silently dropping boundary rows —
+        # the round-2 TPC-H Q6 on-hardware divergence). Rewrite to an
+        # integer compare on the unscaled decimal against a host-computed
+        # boundary that replicates host-f64 semantics bit-for-bit.
+        for a, b, b_expr, flip in ((lv, rv, self.children[1], False),
+                                   (rv, lv, self.children[0], True)):
+            lit = _host_float_value(b_expr, b.dtype)
+            if isinstance(a.dtype, T.DecimalType) \
+                    and isinstance(b.dtype, (T.DoubleType, T.FloatType)) \
+                    and lit is not None:
+                op = _flip_op(self.op) if flip else self.op
+                data = _decimal_vs_float_scalar(a.data, a.dtype.scale,
+                                                lit, op)
+                if data is not None:
+                    return Vec(data, T.BOOLEAN,
+                               _and_valid(lv.validity, rv.validity))
         out = T.common_type(lv.dtype, rv.dtype)
         l = _align(lv, out)
         r = _align(rv, out)
@@ -741,6 +807,73 @@ class BinaryComparison(Expression):
 
 def _flip_op(op: str) -> str:
     return {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
+
+
+def _host_float_value(e: "Expression", dtype: T.DataType) -> Optional[float]:
+    """Host-side float value of a literal expression (the evaluated Vec
+    can't be read back: constants become tracers under jit). FLOAT
+    literals round through f32 first, matching `_align`'s cast chain."""
+    while isinstance(e, (Alias, Cast)):
+        e = e.children[0]
+    if not (isinstance(e, Literal)
+            and isinstance(e.value, (int, float))
+            and not isinstance(e.value, bool)):
+        return None
+    if isinstance(dtype, T.FloatType):
+        return float(np.float64(np.float32(e.value)))
+    return float(e.value)
+
+
+def _decimal_vs_float_scalar(data, scale: int, lit: float, op: str):
+    """Integer-domain rewrite of ``f64(n / 10^scale) OP lit``.
+
+    ``f64(n / 10^s)`` is monotone non-decreasing in the unscaled int n, so
+    each comparison against a float scalar reduces to integer thresholds
+    found by host binary search over exact host f64 — identical results to
+    the CPU path, but only exact int64 compares run on device. Returns
+    None when the rewrite doesn't apply (NaN literal keeps Spark's special
+    NaN ordering on the float path)."""
+    if np.isnan(lit):
+        return None
+    div = np.float64(10.0 ** scale)
+    # the full unscaled int64 domain — values up to 2^63-1 are
+    # representable decimals per types.py
+    lo_b, hi_b = -(1 << 63), (1 << 63) - 1
+
+    def first_n(pred) -> int:
+        """Smallest n in [lo_b, hi_b] with pred(f64(n/10^s)) true; hi_b+1
+        when none (pred is monotone in n)."""
+        lo, hi = lo_b, hi_b + 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if pred(np.float64(mid) / div):
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    n_ge = first_n(lambda v: v >= lit)   # first n with value >= lit
+    n_gt = first_n(lambda v: v > lit)    # first n with value >  lit
+
+    def at_least(n: int):
+        """data >= n, handling the no-n-satisfies sentinel (n > hi_b)."""
+        if n > hi_b:
+            return jnp.zeros(np.shape(data), jnp.bool_)
+        return data >= np.int64(n)
+
+    if op == ">=":
+        return at_least(n_ge)
+    if op == ">":
+        return at_least(n_gt)
+    if op == "<":
+        return ~at_least(n_ge)
+    if op == "<=":
+        return ~at_least(n_gt)
+    if op == "=":
+        return at_least(n_ge) & ~at_least(n_gt)
+    if op == "!=":
+        return ~at_least(n_ge) | at_least(n_gt)
+    return None
 
 
 def _dict_compare_table(dictionary: Optional[pa.Array], value: str, op: str):
@@ -792,6 +925,140 @@ class GE(BinaryComparison):
 
     def _cmp(self, l, r):
         return l >= r
+
+
+class EqNullSafe(BinaryComparison):
+    """`<=>`: NULL <=> NULL is true, NULL <=> x is false — never returns
+    NULL (reference: EqualNullSafe in predicates.scala)."""
+
+    op = "<=>"
+
+    def nullable(self, schema):
+        return False
+
+    def eval(self, batch: Batch) -> Vec:
+        lv = self.children[0].eval(batch)
+        rv = self.children[1].eval(batch)
+        if isinstance(lv.dtype, T.StringType) or \
+                isinstance(rv.dtype, T.StringType):
+            base = EQ(self.children[0], self.children[1]).eval(batch)
+            both_null = self._both_null(lv, rv, np.shape(base.data))
+            ok = base.data
+            if base.validity is not None:
+                ok = ok & base.validity
+            return Vec(ok | both_null, T.BOOLEAN)
+        out = T.common_type(lv.dtype, rv.dtype)
+        l = _align(lv, out)
+        r = _align(rv, out)
+        eq = l == r
+        lval = lv.validity if lv.validity is not None else \
+            jnp.ones((), jnp.bool_)
+        rval = rv.validity if rv.validity is not None else \
+            jnp.ones((), jnp.bool_)
+        both_valid = jnp.broadcast_to(lval & rval, np.shape(eq))
+        both_null = self._both_null(lv, rv, np.shape(eq))
+        return Vec((eq & both_valid) | both_null, T.BOOLEAN)
+
+    @staticmethod
+    def _both_null(lv, rv, shape):
+        ln = ~lv.validity if lv.validity is not None else \
+            jnp.zeros((), jnp.bool_)
+        rn = ~rv.validity if rv.validity is not None else \
+            jnp.zeros((), jnp.bool_)
+        return jnp.broadcast_to(ln & rn, shape)
+
+    def _cmp(self, l, r):
+        raise AssertionError("EqNullSafe.eval is overridden")
+
+
+class _DictStringTransform(Expression):
+    """String function as a host-side dictionary rewrite: device codes
+    are remapped once, per-row work is O(1) (SURVEY.md section 7,
+    'Strings/varlen on TPU')."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def dtype(self, schema):
+        return T.STRING
+
+    def _transform(self, dictionary: pa.Array) -> pa.Array:
+        raise NotImplementedError
+
+    def eval(self, batch):
+        from .columnar import apply_code_remap, dedupe_dictionary
+        v = self.children[0].eval(batch)
+        if v.dictionary is None:
+            raise AnalysisError(
+                f"{type(self).__name__} requires dictionary-encoded strings")
+        new_dict = self._transform(v.dictionary)
+        if isinstance(new_dict, pa.ChunkedArray):
+            new_dict = new_dict.combine_chunks()
+        remap, uniq = dedupe_dictionary(new_dict)
+        return Vec(apply_code_remap(v.data, remap), T.STRING, v.validity,
+                   uniq)
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.children[0]!r})"
+
+
+class Upper(_DictStringTransform):
+    def _transform(self, d):
+        return pc.utf8_upper(d)
+
+
+class Lower(_DictStringTransform):
+    def _transform(self, d):
+        return pc.utf8_lower(d)
+
+
+class Trim(_DictStringTransform):
+    def _transform(self, d):
+        return pc.utf8_trim_whitespace(d)
+
+
+class ConcatLit(_DictStringTransform):
+    """concat with string literals around one string column (general
+    column-column concat would need a product dictionary)."""
+
+    def __init__(self, child: Expression, prefix: str = "", suffix: str = ""):
+        super().__init__(child)
+        self.prefix = prefix
+        self.suffix = suffix
+
+    def _transform(self, d):
+        if d.type != pa.string():
+            d = d.cast(pa.string())
+        return pc.binary_join_element_wise(
+            pa.array([self.prefix] * len(d)), d,
+            pa.array([self.suffix] * len(d)), pa.scalar(""))
+
+    def __repr__(self):
+        return (f"concat({self.prefix!r}, {self.children[0]!r}, "
+                f"{self.suffix!r})")
+
+
+class StringLength(Expression):
+    """length(str): a host dictionary lookup table, gathered by code."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    def dtype(self, schema):
+        return T.INT
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.dictionary is None:
+            raise AnalysisError("length requires dictionary-encoded strings")
+        table = jnp.asarray(
+            pc.utf8_length(v.dictionary).to_numpy(zero_copy_only=False)
+            .astype(np.int32))
+        data = jnp.take(table, jnp.clip(v.data, 0, table.shape[0] - 1))
+        return Vec(data, T.INT, v.validity)
+
+    def __repr__(self):
+        return f"length({self.children[0]!r})"
 
 
 class And(Expression):
@@ -1033,6 +1300,16 @@ class CaseWhen(Expression):
         if otherwise is not None:
             flat.append(otherwise)
         self.children = tuple(flat)
+
+    def map_children(self, f):
+        # branches/otherwise are views over `children`; the base
+        # copy-and-replace would leave them pointing at stale nodes
+        # (eval reads self.branches, not self.children)
+        new_kids = [f(c) for c in self.children]
+        n = len(self.branches)
+        branches = [(new_kids[2 * i], new_kids[2 * i + 1]) for i in range(n)]
+        otherwise = new_kids[2 * n] if self.otherwise is not None else None
+        return CaseWhen(branches, otherwise)
 
     def dtype(self, schema):
         dts = [v.dtype(schema) for _, v in self.branches]

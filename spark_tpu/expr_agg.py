@@ -221,15 +221,108 @@ class Avg(AggregateFunction):
     def device_finalize(self, accs, schema):
         dt = self.child.dtype(schema)
         if isinstance(dt, T.DecimalType):
+            # exact integer HALF_UP, matching the host `finalize` digit for
+            # digit (the former float64 round-trip diverged in the last
+            # digit — and TPU f64 is emulated, compounding it). Split as
+            # q*extra + round(r*extra/cnt) so intermediates stay in int64.
             total, cnt = accs
             out_dt = self.result_type(schema)
-            extra = 10.0 ** (out_dt.scale - dt.scale)
-            safe = jnp.where(cnt > 0, cnt, 1)
-            return jnp.round(total.astype(jnp.float64) * extra / safe) \
-                .astype(jnp.int64), cnt > 0
+            extra = jnp.int64(10 ** (out_dt.scale - dt.scale))
+            safe = jnp.where(cnt > 0, cnt, 1).astype(jnp.int64)
+            absn = jnp.abs(total)
+            q0 = absn // safe
+            r0 = absn - q0 * safe
+            frac = (r0 * extra + safe // 2) // safe  # HALF_UP
+            mag = q0 * extra + frac
+            return jnp.where(total < 0, -mag, mag), cnt > 0
         total, cnt = accs
         safe = jnp.where(cnt > 0, cnt, 1)
         return (total / safe).astype(jnp.float64), cnt > 0
+
+
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT x): a planning marker — the optimizer's
+    RewriteDistinctAggregates expands it into a two-level aggregate
+    (dedupe on (groups, x), then count), the single-distinct case of the
+    reference's `AggUtils.planAggregateWithOneDistinct`. It never reaches
+    physical execution itself."""
+
+    def result_type(self, schema):
+        return T.LONG
+
+    def result_nullable(self, schema):
+        return False
+
+    def accumulators(self, schema):
+        raise NotImplementedError(
+            "count(DISTINCT) must be rewritten before execution")
+
+    def __repr__(self):
+        return f"count(DISTINCT {self.child!r})"
+
+
+class _CentralMoment(AggregateFunction):
+    """Variance/stddev via raw power sums (cnt, sum x, sum x^2) — all
+    three are plain associative SUM accumulators, so the partial/final
+    split and mesh psum merges work unchanged (the reference's
+    `CentralMomentAgg.scala` carries (n, avg, m2) with a merge formula
+    instead; power sums trade a little conditioning for fitting the
+    declarative reduce model, and the f64 accumulator is ample for the
+    engine's test/bench ranges)."""
+
+    _sample = True   # ddof=1
+    _sqrt = False
+
+    def result_type(self, schema):
+        return T.DOUBLE
+
+    def accumulators(self, schema):
+        return [AccSpec("cnt", np.dtype(np.int64), "sum", width=8),
+                AccSpec("sx", np.dtype(np.float64), "sum"),
+                AccSpec("sxx", np.dtype(np.float64), "sum")]
+
+    def update(self, batch, sel):
+        v, m = self._eval_child(batch, sel)
+        x = cast_vec(v, T.DOUBLE).data
+        cnt = jnp.ones((batch.capacity,), jnp.int64)
+        if m is not None:
+            x = jnp.where(m, x, 0.0)
+            cnt = jnp.where(m, cnt, 0)
+        return [cnt, x, x * x]
+
+    def _finish(self, cnt, sx, sxx, xp):
+        ddof = 1 if self._sample else 0
+        denom = xp.maximum(cnt - ddof, 1)
+        mean = sx / xp.maximum(cnt, 1)
+        m2 = xp.maximum(sxx - sx * mean, 0.0)  # clamp the cancellation
+        var = m2 / denom
+        out = xp.sqrt(var) if self._sqrt else var
+        valid = cnt > (1 if self._sample else 0)
+        return out, valid
+
+    def finalize(self, accs, schema):
+        cnt, sx, sxx = accs
+        return self._finish(np.asarray(cnt, np.float64), sx, sxx, np)
+
+    def device_finalize(self, accs, schema):
+        cnt, sx, sxx = accs
+        return self._finish(cnt.astype(jnp.float64), sx, sxx, jnp)
+
+
+class VarianceSamp(_CentralMoment):
+    _sample, _sqrt = True, False
+
+
+class VariancePop(_CentralMoment):
+    _sample, _sqrt = False, False
+
+
+class StddevSamp(_CentralMoment):
+    _sample, _sqrt = True, True
+
+
+class StddevPop(_CentralMoment):
+    _sample, _sqrt = False, True
 
 
 class _MinMax(AggregateFunction):

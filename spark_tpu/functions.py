@@ -5,9 +5,12 @@ from __future__ import annotations
 from typing import Optional, Union
 
 from . import types as T
-from .expr import (CaseWhen, ColumnRef, Expression, ExtractYear, Literal,
-                   date_literal)
-from .expr_agg import AggExpr, Avg, Count, Max, Min, Sum
+from .expr import (CaseWhen, ColumnRef, ConcatLit, DateAdd, EqNullSafe,
+                   Expression, ExtractDay, ExtractMonth, ExtractYear,
+                   Literal, Lower, StringLength, Trim, Upper, date_literal)
+from .expr_agg import (AggExpr, Avg, Count, CountDistinct, Max, Min,
+                       StddevPop, StddevSamp, Sum, VariancePop,
+                       VarianceSamp)
 
 
 def col(name: str) -> ColumnRef:
@@ -56,6 +59,95 @@ def max(e) -> Max:  # noqa: A001
 
 def year(e) -> ExtractYear:
     return ExtractYear(_expr(e))
+
+
+def month(e) -> ExtractMonth:
+    return ExtractMonth(_expr(e))
+
+
+def day(e) -> ExtractDay:
+    return ExtractDay(_expr(e))
+
+
+dayofmonth = day
+
+
+def date_add(e, days) -> DateAdd:
+    return DateAdd(_expr(e), _expr(days))
+
+
+def date_sub(e, days) -> DateAdd:
+    from .expr import Neg
+    d = _expr(days)
+    if isinstance(d, Literal) and isinstance(d.value, int):
+        return DateAdd(_expr(e), Literal(-d.value))
+    return DateAdd(_expr(e), Neg(d))
+
+
+def stddev(e) -> StddevSamp:
+    return StddevSamp(_expr(e))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(e) -> StddevPop:
+    return StddevPop(_expr(e))
+
+
+def variance(e) -> VarianceSamp:
+    return VarianceSamp(_expr(e))
+
+
+var_samp = variance
+
+
+def var_pop(e) -> VariancePop:
+    return VariancePop(_expr(e))
+
+
+def count_distinct(e) -> CountDistinct:
+    return CountDistinct(_expr(e))
+
+
+countDistinct = count_distinct
+
+
+def upper(e) -> Upper:
+    return Upper(_expr(e))
+
+
+def lower(e) -> Lower:
+    return Lower(_expr(e))
+
+
+def trim(e) -> Trim:
+    return Trim(_expr(e))
+
+
+def length(e) -> StringLength:
+    return StringLength(_expr(e))
+
+
+def concat(*parts) -> Expression:
+    """concat of string literals around ONE string column (general
+    column-column concat needs a product dictionary — unsupported)."""
+    exprs = [_expr(p) for p in parts]
+    col_idx = [i for i, p in enumerate(exprs)
+               if not isinstance(p, Literal)]
+    if len(col_idx) != 1:
+        from .expr import AnalysisError
+        raise AnalysisError("concat supports exactly one non-literal "
+                            "string argument")
+    i = col_idx[0]
+    prefix = "".join(str(p.value) for p in exprs[:i])
+    suffix = "".join(str(p.value) for p in exprs[i + 1:])
+    return ConcatLit(exprs[i], prefix, suffix)
+
+
+def eq_null_safe(a, b) -> EqNullSafe:
+    """a <=> b (reference: EqualNullSafe)."""
+    return EqNullSafe(_expr(a), _expr(b))
 
 
 def pmod(dividend, divisor) -> Expression:

@@ -99,9 +99,11 @@ def hash_rows(batch: Batch, key_names: Sequence[str]):
     return h
 
 
-def _scatter_to_buckets(batch: Batch, tgt, n: int):
-    """Sort rows by target shard and scatter into an [n*L] send layout.
-    Returns (flat_idx, perm): row perm[r] goes to flat slot flat_idx[r]."""
+def _scatter_to_buckets(batch: Batch, tgt, n: int, block: int):
+    """Sort rows by target shard and scatter into an [n*block] send layout
+    (`block` slots per destination). Returns (flat_idx, perm, max_count):
+    row perm[r] goes to flat slot flat_idx[r]; rows past a full bucket
+    drop (the caller flags overflow off max_count and retries bigger)."""
     L = batch.capacity
     tgt_s, perm = jax.lax.sort(
         (tgt, jnp.arange(L, dtype=jnp.int32)), num_keys=1)
@@ -110,33 +112,49 @@ def _scatter_to_buckets(batch: Batch, tgt, n: int):
     starts = jnp.cumsum(counts) - counts  # exclusive, [n+1]
     pos = jnp.arange(L, dtype=jnp.int32) - jnp.take(starts,
                                                     jnp.clip(tgt_s, 0, n))
-    flat = jnp.where(tgt_s < n, tgt_s * L + pos, n * L)
-    return flat, perm
+    flat = jnp.where((tgt_s < n) & (pos < block), tgt_s * block + pos,
+                     n * block)
+    return flat, perm, jnp.max(counts[:n])
 
 
-def exchange_hash(batch: Batch, key_names: Sequence[str], ctx) -> Batch:
+def exchange_hash(batch: Batch, key_names: Sequence[str], ctx,
+                  block_cap: Optional[int] = None,
+                  tag: str = "e0") -> Batch:
     """HashPartitioning exchange: radix-partition + all_to_all.
 
-    Output capacity is n*L (every shard can in the worst case receive the
-    whole input — skew-safe without dynamic shapes)."""
+    `block_cap` is the per-(source, destination) slot count, so each shard
+    receives at most n*block_cap rows. The round-2 design used block_cap=L
+    (worst case: one shard receives everything) — 8x the input per shard
+    at mesh 8, an OOM at any serious scale. The default now seeds
+    2*ceil(L/n) (2x a uniform hash spread, the `MapOutputTracker`-style
+    size assumption); the actual per-bucket max is surfaced as the
+    `exch_max_<tag>` metric and an `exch_overflow_<tag>` flag, and the
+    executor's stats->re-plan loop re-jits with a sufficient capacity when
+    skew overflows it — the AQE pattern joins already use."""
     n = ctx.n_shards
     axis = ctx.axis_name
     L = batch.capacity
+    if block_cap is None:
+        from ..columnar import bucket_capacity
+        block_cap = min(L, bucket_capacity(-(-2 * L // n)))  # ceil(2L/n)
+    block = block_cap
     sel = batch.selection_mask()
     h = hash_rows(batch, key_names)
     tgt = (h.astype(jnp.uint64) % np.uint64(n)).astype(jnp.int32)
     tgt = jnp.where(sel, tgt, n)  # dead rows dropped
-    flat, perm = _scatter_to_buckets(batch, tgt, n)
+    flat, perm, max_count = _scatter_to_buckets(batch, tgt, n, block)
+    ctx.add_metric(f"exch_max_{tag}", max_count)
+    ctx.add_flag(f"exch_overflow_{tag}", max_count > block)
 
     def send_recv(x, fill=0):
         x_s = jnp.take(x, perm)
-        send = jnp.full((n * L,), fill, x.dtype).at[flat].set(
+        send = jnp.full((n * block,), fill, x.dtype).at[flat].set(
             x_s, mode="drop")
-        return jax.lax.all_to_all(send.reshape(n, L), axis, 0, 0
-                                  ).reshape(n * L)
+        return jax.lax.all_to_all(send.reshape(n, block), axis, 0, 0
+                                  ).reshape(n * block)
 
     live = send_recv(sel & (tgt >= 0), fill=False)  # scattered True marks
-    # NOTE: `sel & (tgt>=0)` == sel; dead rows never scatter (flat == n*L)
+    # NOTE: `sel & (tgt>=0)` == sel; dead rows never scatter (flat OOB)
     cols: Dict[str, Column] = {}
     for name, col in batch.columns.items():
         data = send_recv(col.data)

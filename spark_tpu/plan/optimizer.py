@@ -220,8 +220,50 @@ class ConstantFolding(Rule):
         return plan.transform_up(f)
 
 
+class RewriteDistinctAggregates(Rule):
+    """count(DISTINCT x) -> count(x) over a (groups, x) dedupe aggregate —
+    the single-distinct case of the reference's
+    `AggUtils.planAggregateWithOneDistinct` (Expand-based mixed plans are
+    not supported; mixing distinct and plain aggregates raises)."""
+
+    name = "RewriteDistinctAggregates"
+
+    def apply(self, plan):
+        from ..expr_agg import AggExpr, Count, CountDistinct
+
+        def f(node):
+            if not isinstance(node, Aggregate):
+                return node
+            distinct = [a for a in node.agg_exprs
+                        if isinstance(a.func, CountDistinct)]
+            if not distinct:
+                return node
+            if len(distinct) != len(node.agg_exprs):
+                from ..expr import AnalysisError
+                raise AnalysisError(
+                    "mixing count(DISTINCT) with other aggregates is not "
+                    "supported yet")
+            firsts = [a.func.child for a in distinct]
+            from ..expr import structurally_equal
+            if not all(structurally_equal(firsts[0], e) for e in firsts[1:]):
+                from ..expr import AnalysisError
+                raise AnalysisError(
+                    "multiple count(DISTINCT) on different expressions is "
+                    "not supported yet")
+            dedup_key = Alias(firsts[0], "__distinct_key")
+            inner = Aggregate(node.child,
+                              list(node.group_exprs) + [dedup_key], [])
+            outer_groups = [ColumnRef(g.name()) for g in node.group_exprs]
+            outer_aggs = [AggExpr(Count(ColumnRef("__distinct_key")),
+                                  a.out_name) for a in distinct]
+            return Aggregate(inner, outer_groups, outer_aggs)
+
+        return plan.transform_up(f)
+
+
 def default_optimizer() -> RuleExecutor:
     return RuleExecutor([
+        Batch("Rewrite", [RewriteDistinctAggregates()], strategy="once"),
         Batch("Filter pushdown", [
             CombineFilters(),
             PushFilterThroughProject(),

@@ -682,18 +682,31 @@ class JoinExec(PhysicalPlan):
             return [UnspecifiedDistribution(), BroadcastDistribution()]
         lk, rk = self._clusterable_key_names()
         if not lk:
+            if self.how in ("right", "full"):
+                # a replicated build would append its locally-unmatched
+                # rows on EVERY shard (n-fold duplication); with no key
+                # columns to hash-partition on, co-locate everything and
+                # let the striped SinglePartition output dedupe
+                return [AllTuples(), AllTuples()]
             # no hashable key columns (e.g. cross join's literal keys):
             # every probe row must see every build row -> replicate build
             return [UnspecifiedDistribution(), BroadcastDistribution()]
         return [ClusteredDistribution(lk), ClusteredDistribution(rk)]
 
     def output_partitioning(self):
+        lp = self.left.output_partitioning()
+        rp = self.right.output_partitioning()
+        if isinstance(lp, SinglePartition) and \
+                isinstance(rp, (SinglePartition, Replicated)):
+            # both sides fully co-located: every shard computed the same
+            # complete result (valid for every join type incl. outer)
+            return SinglePartition()
         if self.how in ("right", "full"):
             # appended null-extended rows carry NULL left keys on whatever
             # shard held the unmatched build row — no layout guarantee
             # (the reference returns UnknownPartitioning here too)
             return UnknownPartitioning()
-        return self.left.output_partitioning()
+        return lp
 
     def _eval_keys(self, probe_batch, build_batch):
         def bcast(v: Vec, cap: int) -> Vec:
@@ -988,6 +1001,10 @@ class ExchangeExec(UnaryExec):
     def __init__(self, child: PhysicalPlan, partitioning: Partitioning):
         self.children = (child,)
         self.partitioning = partitioning
+        #: per-(src,dst) receive block size; None = seeded from the input
+        #: capacity (2x uniform spread), grown by the executor on overflow
+        self.block_cap: Optional[int] = None
+        self.tag = "e0"
 
     def schema(self):
         return self.child.schema()
@@ -1001,14 +1018,15 @@ class ExchangeExec(UnaryExec):
         from ..parallel import shuffle
         if isinstance(self.partitioning, HashPartitioning):
             return shuffle.exchange_hash(inputs[0], self.partitioning.keys,
-                                         ctx)
+                                         ctx, block_cap=self.block_cap,
+                                         tag=self.tag)
         if isinstance(self.partitioning, (SinglePartition, Replicated)):
             return shuffle.all_gather_batch(inputs[0], ctx)
         raise AnalysisError(
             f"no collective lowering for {self.partitioning!r}")
 
     def simple_string(self):
-        return f"ExchangeExec({self.partitioning!r})"
+        return f"ExchangeExec({self.partitioning!r}, block={self.block_cap})"
 
 
 class UnionExec(PhysicalPlan):
@@ -1020,9 +1038,33 @@ class UnionExec(PhysicalPlan):
     def schema(self):
         return self._schema
 
+    def output_partitioning(self):
+        # per-shard concatenation of sharded children is NOT a single
+        # partition: inheriting the base SinglePartition would both skip
+        # needed exchanges above and make the executor stripe the
+        # (distinct) per-shard output (round-2 high-severity bug)
+        lp = self.children[0].output_partitioning()
+        rp = self.children[1].output_partitioning()
+        if isinstance(lp, SinglePartition) and isinstance(rp, SinglePartition):
+            return SinglePartition()
+        return UnknownPartitioning(
+            max(lp.num_partitions, rp.num_partitions))
+
     def compute(self, ctx, inputs):
         from ..columnar import unify_string_columns
         lb, rb = inputs
+        if ctx.axis_name is not None and ctx.n_shards > 1:
+            # a SinglePartition child is physically replicated on every
+            # shard; concatenated as-is it would appear n times in the
+            # gathered output — take this shard's stripe so the union
+            # totals exactly one copy per side
+            from ..parallel.shuffle import stripe_batch
+            parts = [c.output_partitioning() for c in self.children]
+            if not all(isinstance(p, SinglePartition) for p in parts):
+                if isinstance(parts[0], (SinglePartition, Replicated)):
+                    lb = stripe_batch(lb, ctx)
+                if isinstance(parts[1], (SinglePartition, Replicated)):
+                    rb = stripe_batch(rb, ctx)
         cols = {}
         for out_f, ln, rn in zip(self._schema.fields, lb.names, rb.names):
             lc, rc = lb.columns[ln], rb.columns[rn]

@@ -34,9 +34,10 @@ def plan_physical(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
 
 
 def _assign_join_tags(plan: P.PhysicalPlan) -> None:
-    """Stable per-node tags for join overflow flags/metrics (the executor's
-    capacity-retry loop keys on them)."""
+    """Stable per-node tags for join/exchange overflow flags+metrics (the
+    executor's capacity-retry loop keys on them)."""
     counter = [0]
+    ex_counter = [0]
 
     def walk(node):
         for c in node.children:
@@ -44,6 +45,9 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
         if isinstance(node, P.JoinExec):
             node.tag = f"j{counter[0]}"
             counter[0] += 1
+        elif isinstance(node, P.ExchangeExec):
+            node.tag = f"e{ex_counter[0]}"
+            ex_counter[0] += 1
 
     walk(plan)
 
@@ -62,6 +66,13 @@ def estimate_rows(plan: L.LogicalPlan) -> Optional[int]:
         return plan.n if child is None else min(plan.n, child)
     if isinstance(plan, L.Aggregate):
         return estimate_rows(plan.children[0])
+    if isinstance(plan, L.Join) and plan.how == "inner":
+        # FK-join heuristic: output cardinality ~ the fact side's (drives
+        # probe/build-side ordering in the SQL frontend's join search)
+        l = estimate_rows(plan.children[0])
+        r = estimate_rows(plan.children[1])
+        if l is not None and r is not None:
+            return max(l, r)
     return None
 
 
