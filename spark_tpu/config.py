@@ -127,23 +127,36 @@ BATCH_BUCKET_GROWTH = register(
         "sizes (static-shape discipline, SURVEY.md section 7).")
 
 STREAMING_CHUNK_ROWS = register(
-    "spark_tpu.sql.execution.streamingChunkRows", 1 << 26,
+    "spark_tpu.sql.execution.streamingChunkRows", 1 << 24,
     doc="Chunk size (rows) for streaming large scans through aggregates "
         "with carried accumulator tables; bounds HBM residency of a scan "
-        "the way the reference's row-iterator pipeline does.")
+        "the way the reference's row-iterator pipeline does. (1<<26 "
+        "chunks faulted the v5e runtime on wide-domain aggregates.)")
 
 ADAPTIVE_ENABLED = register(
     "spark_tpu.sql.adaptive.enabled", True,
-    doc="Enable adaptive re-planning between stages from runtime row "
-        "counts (analog of spark.sql.adaptive.enabled).")
+    doc="Enable the stats->re-jit retry loop for join/exchange/aggregate "
+        "capacity overflows (analog of spark.sql.adaptive.enabled). "
+        "Disabled, an overflow raises instead of re-planning.")
 
 CASE_SENSITIVE = register(
     "spark_tpu.sql.caseSensitive", False,
-    doc="Whether column resolution is case sensitive.")
+    doc="Whether column resolution is case sensitive (analog of "
+        "spark.sql.caseSensitive).")
 
-ANSI_ENABLED = register(
-    "spark_tpu.sql.ansi.enabled", False,
-    doc="ANSI mode: overflow/ invalid-cast errors instead of nulls.")
+# NOTE: no ANSI mode entry — ANSI error semantics (overflow/invalid-cast
+# errors instead of NULLs) are not implemented; registering a flag that
+# silently does nothing would be worse than absent (round-2 ADVICE).
+
+METRICS_ENABLED = register(
+    "spark_tpu.sql.metrics.enabled", True,
+    doc="Record per-operator output row counts during execution "
+        "(surfaced by explain(runtime=True); analog of SQLMetrics).")
+
+PROFILE_DIR = register(
+    "spark_tpu.sql.profile.dir", "",
+    doc="When set, wrap query execution in a jax.profiler trace written "
+        "to this directory (one trace per execute).")
 
 MESH_SIZE = register(
     "spark_tpu.sql.mesh.size", 0,

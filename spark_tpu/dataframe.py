@@ -35,7 +35,18 @@ class DataFrame:
 
     def select(self, *exprs) -> "DataFrame":
         es = [_expr(e) for e in exprs]
-        return self._with(L.Project(self.plan, es))
+        plan, es = self._extract_windows(es)
+        return self._with(L.Project(plan, es))
+
+    def _extract_windows(self, exprs: List[Expression]):
+        """Pull WindowExpr nodes out into Window plan nodes below the
+        projection (the reference's ExtractWindowExpressions analog); the
+        projection then references their output columns. Functions
+        sharing a spec land in ONE Window node (one sort), and output
+        names never collide with existing columns (the projection
+        re-aliases)."""
+        from .window import extract_window_exprs
+        return extract_window_exprs(self.plan, exprs)
 
     def filter(self, condition: Expression) -> "DataFrame":
         return self._with(L.Filter(self.plan, condition))
@@ -53,7 +64,8 @@ class DataFrame:
                 exprs.append(ColumnRef(n))
         if not replaced:
             exprs.append(Alias(_expr(e), name))
-        return self._with(L.Project(self.plan, exprs))
+        plan, exprs = self._extract_windows(exprs)
+        return self._with(L.Project(plan, exprs))
 
     withColumn = with_column
 
@@ -173,8 +185,13 @@ class DataFrame:
     def columns(self) -> List[str]:
         return self.plan.schema().names
 
-    def explain(self, extended: bool = False) -> None:
-        print(self._qe().explain(extended))
+    def explain(self, extended: bool = False, runtime: bool = False) -> None:
+        """Print the plan. runtime=True re-executes and annotates each
+        operator with its output row count (SQLMetrics analog)."""
+        qe = self._qe()
+        if runtime:
+            qe.execute_batch()
+        print(qe.explain(extended, runtime=runtime))
 
     # -- actions ------------------------------------------------------------
 

@@ -121,9 +121,16 @@ def direct_update(tables, idx, total, contribs: List[List],
     backend = jax.default_backend()
     use_kernel = (kernel_mode == "matmul"
                   or (kernel_mode == "auto" and backend == "tpu"))
+    has_float = any(np.issubdtype(spec.np_dtype, np.floating)
+                    for row in specs for spec in row)
+    if has_float and total > 512:
+        # the VPU masked-reduce float path costs O(domain) per row; the
+        # factorized MXU kernel is int-only — scatter instead
+        use_kernel = False
     if all_sum and use_kernel and total <= (1 << 20) and idx.shape[0] >= 128:
         from .pallas_groupby import dense_groupby_sums
         int_rows = [jnp.ones(idx.shape, jnp.int64)]
+        int_widths = [8]  # the occupancy count contributes 0/1
         float_rows = []
         layout = []  # (row_kind, index) per (i, j)
         for contrib_row, spec_row in zip(contribs, specs):
@@ -134,9 +141,10 @@ def direct_update(tables, idx, total, contribs: List[List],
                 else:
                     layout.append(("i", len(int_rows)))
                     int_rows.append(contrib.astype(jnp.int64))
+                    int_widths.append(spec.width)
         int_sums, float_sums = dense_groupby_sums(
             idx, int_rows, float_rows, total,
-            interpret=(backend != "tpu"))
+            interpret=(backend != "tpu"), int_widths=int_widths)
         cnt = cnt + int_sums[0]
         new_accs = []
         k = 0
@@ -200,10 +208,13 @@ def direct_aggregate(key_vecs: Sequence[Vec],
 def sort_aggregate(key_vecs: Sequence[Vec],
                    contribs: List[List], specs: List[List[AccSpec]],
                    sel, capacity: int, num_segments: Optional[int] = None
-                   ) -> Tuple[List, List, List, object]:
+                   ) -> Tuple[List, List, List, object, object]:
     """General sort-based aggregation.
 
-    Returns (key_arrays, key_validities, acc_arrays, occupied).
+    Returns (key_arrays, key_validities, acc_arrays, occupied,
+    total_groups). Groups beyond `num_segments` are dropped — the caller
+    must flag `total_groups > num_segments` and retry with capacity
+    (the join/exchange AQE loop pattern).
     """
     num_segments = num_segments or capacity
     operands = []
@@ -228,8 +239,10 @@ def sort_aggregate(key_vecs: Sequence[Vec],
         diff = diff | (op != shifted)
     first = jnp.arange(capacity) == 0
     starts = (first | diff) & valid_sorted
+    total_groups = jnp.sum(starts.astype(jnp.int32))
     gid = jnp.cumsum(starts.astype(jnp.int32)) - 1
-    gid = jnp.where(valid_sorted, gid, num_segments)  # OOB -> dropped
+    gid = jnp.where(valid_sorted & (gid < num_segments), gid,
+                    num_segments)  # OOB -> dropped (flagged by caller)
 
     occupied_cnt = jnp.zeros((num_segments,), jnp.int32).at[gid].add(
         jnp.ones_like(gid), mode="drop")
@@ -269,4 +282,4 @@ def sort_aggregate(key_vecs: Sequence[Vec],
             key_valids.append(kv)
         else:
             key_valids.append(None)
-    return key_arrays, key_valids, accs, occupied_cnt > 0
+    return key_arrays, key_valids, accs, occupied_cnt > 0, total_groups

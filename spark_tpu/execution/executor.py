@@ -35,11 +35,20 @@ class QueryExecution:
         self._optimized: Optional[L.LogicalPlan] = None
         self._executed: Optional[P.PhysicalPlan] = None
         self.phase_times: Dict[str, float] = {}
+        self.last_metrics: Dict[str, int] = {}
+
+    def _activate_conf(self) -> None:
+        """Apply session conf to analysis-time globals (the reference's
+        SQLConf thread-activation; the driver is single-threaded)."""
+        from .. import expr as expr_mod
+        expr_mod.CASE_SENSITIVE = bool(
+            self.session.conf.get("spark_tpu.sql.caseSensitive"))
 
     @property
     def analyzed(self) -> L.LogicalPlan:
         if self._analyzed is None:
             t0 = time.perf_counter()
+            self._activate_conf()
             self.logical.schema()  # eager name/type resolution raises here
             self._analyzed = self.logical
             self.phase_times["analysis"] = time.perf_counter() - t0
@@ -62,14 +71,28 @@ class QueryExecution:
             self.phase_times["planning"] = time.perf_counter() - t0
         return self._executed
 
-    def explain(self, extended: bool = False) -> str:
+    def explain(self, extended: bool = False, runtime: bool = False) -> str:
         out = []
         if extended:
             out += ["== Logical Plan ==", self.logical.tree_string(),
                     "== Optimized Logical Plan ==",
                     self.optimized_plan.tree_string()]
-        out += ["== Physical Plan ==", self.executed_plan.tree_string()]
+        if runtime and self.last_metrics:
+            out.append("== Physical Plan (runtime metrics) ==")
+            out.append(self._runtime_tree(self.executed_plan))
+        else:
+            out += ["== Physical Plan ==",
+                    self.executed_plan.tree_string()]
         return "\n".join(out)
+
+    def _runtime_tree(self, node: P.PhysicalPlan, depth: int = 0) -> str:
+        """Tree annotated with per-operator output rows (the SQL-UI plan
+        graph analog of `metric/SQLMetrics.scala:40`)."""
+        rows = self.last_metrics.get(f"rows_{getattr(node, 'op_tag', '')}")
+        note = f"   [rows out: {rows:,}]" if rows is not None else ""
+        line = "  " * depth + node.simple_string() + note
+        return "\n".join([line] + [self._runtime_tree(c, depth + 1)
+                                   for c in node.children])
 
     # -- execution ----------------------------------------------------------
 
@@ -100,10 +123,15 @@ class QueryExecution:
     def _compile_stage(self, root: P.PhysicalPlan, mesh=None):
         conf = self.session.conf
         n = int(mesh.devices.size) if mesh is not None else 1
-        key = root.describe() + (f"#mesh{n}" if mesh is not None else "")
+        metrics_on = bool(conf.get("spark_tpu.sql.metrics.enabled"))
+        key = (root.describe()
+               + (f"#mesh{n}" if mesh is not None else "")
+               + f"#m{int(metrics_on)}")
         fn = self.session._stage_cache.get(key)
         if fn is not None:
             return fn
+
+        per_op = bool(conf.get("spark_tpu.sql.metrics.enabled"))
 
         def replay_root(ctx, inputs):
             counter = [0]
@@ -114,7 +142,15 @@ class QueryExecution:
                     counter[0] += 1
                     return b
                 child_batches = [replay(c) for c in node.children]
-                return node.compute(ctx, child_batches)
+                out = node.compute(ctx, child_batches)
+                if per_op:
+                    # rows-out per operator, psum'd across shards — the
+                    # SQLMetrics.scala:40 analog, shown by
+                    # explain(runtime=True)
+                    ctx.add_metric(
+                        f"rows_{getattr(node, 'op_tag', 'op?')}",
+                        jnp.sum(out.selection_mask().astype(jnp.int64)))
+                return out
 
             return replay(root)
 
@@ -150,8 +186,11 @@ class QueryExecution:
                     for k, v in ctx.flags.items()}
                 metrics = {}
                 for k, v in ctx.metrics.items():
+                    # capacity-sizing stats take the worst shard (pmax);
+                    # row counts sum across shards
                     red = jax.lax.pmax if k.startswith(
-                        ("join_rows_", "exch_max_")) else jax.lax.psum
+                        ("join_rows_", "exch_max_", "agg_groups_")) \
+                        else jax.lax.psum
                     metrics[k] = red(jnp.asarray(v), AXIS)
                 return out, flags, metrics
 
@@ -177,6 +216,13 @@ class QueryExecution:
         if isinstance(root, P.ExchangeExec) and root.tag == tag:
             root.block_cap = cap
 
+    @staticmethod
+    def _set_agg_groups(root: P.PhysicalPlan, tag: str, est: int) -> None:
+        for c in root.children:
+            QueryExecution._set_agg_groups(c, tag, est)
+        if isinstance(root, P.HashAggregateExec) and root.tag == tag:
+            root.est_groups = est
+
     def execute_batch(self) -> Tuple[Batch, Dict, Dict]:
         """Run the query, returning (device Batch, flags, metrics).
 
@@ -187,6 +233,7 @@ class QueryExecution:
         host loop, `AdaptiveSparkPlanExec.scala:64`)."""
         from ..columnar import bucket_capacity
         from ..parallel.mesh import get_mesh
+        self._activate_conf()
         mesh = get_mesh(self.session.conf)
         if mesh is None:
             root = self._materialize_streaming(self.executed_plan)
@@ -209,32 +256,54 @@ class QueryExecution:
         token = None
         if mesh is not None:
             token = jnp.zeros((int(mesh.devices.size),), jnp.int32)
-        for _attempt in range(8):
-            fn = self._compile_stage(root, mesh)
-            if mesh is None:
-                batch, flags, metrics = fn(scan_batches)
-            else:
-                batch, flags, metrics = fn(scan_batches, token)
-            overflow = [k for k, v in flags.items()
-                        if k.startswith(("join_overflow_", "exch_overflow_"))
-                        and bool(np.asarray(v))]
-            if not overflow:
-                break
-            for k in overflow:
-                if k.startswith("join_overflow_"):
-                    tag = k[len("join_overflow_"):]
-                    total = int(np.asarray(metrics[f"join_rows_{tag}"]))
-                    self._set_join_cap(root, tag,
-                                       bucket_capacity(max(total, 8)))
+        adaptive = bool(self.session.conf.get("spark_tpu.sql.adaptive.enabled"))
+        profile_dir = str(self.session.conf.get("spark_tpu.sql.profile.dir"))
+        import contextlib
+        prof = jax.profiler.trace(profile_dir) if profile_dir else \
+            contextlib.nullcontext()
+        with prof:
+            for _attempt in range(8):
+                fn = self._compile_stage(root, mesh)
+                if mesh is None:
+                    batch, flags, metrics = fn(scan_batches)
                 else:
-                    tag = k[len("exch_overflow_"):]
-                    mx = int(np.asarray(metrics[f"exch_max_{tag}"]))
-                    self._set_exchange_cap(root, tag,
-                                           bucket_capacity(max(mx, 8)))
-        else:
-            raise RuntimeError("join output capacity did not converge")
+                    batch, flags, metrics = fn(scan_batches, token)
+                overflow = [k for k, v in flags.items()
+                            if k.startswith(("join_overflow_",
+                                             "exch_overflow_",
+                                             "agg_overflow_"))
+                            and bool(np.asarray(v))]
+                if not overflow:
+                    break
+                if not adaptive:
+                    raise RuntimeError(
+                        f"capacity overflow in {overflow} with adaptive "
+                        f"re-planning disabled "
+                        f"(spark_tpu.sql.adaptive.enabled=false)")
+                for k in overflow:
+                    if k.startswith("join_overflow_"):
+                        tag = k[len("join_overflow_"):]
+                        total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                        self._set_join_cap(root, tag,
+                                           bucket_capacity(max(total, 8)))
+                    elif k.startswith("exch_overflow_"):
+                        tag = k[len("exch_overflow_"):]
+                        mx = int(np.asarray(metrics[f"exch_max_{tag}"]))
+                        self._set_exchange_cap(root, tag,
+                                               bucket_capacity(max(mx, 8)))
+                    else:
+                        tag = k[len("agg_overflow_"):]
+                        total = int(np.asarray(
+                            metrics[f"agg_groups_{tag}"]))
+                        self._set_agg_groups(root, tag, max(total, 8))
+            else:
+                raise RuntimeError(
+                    f"capacity retries did not converge; still "
+                    f"overflowing: {overflow}")
         batch = jax.block_until_ready(batch)
         self.phase_times["execution"] = time.perf_counter() - t0
+        self.last_metrics = {k: int(np.asarray(v))
+                             for k, v in metrics.items()}
         return batch, flags, metrics
 
     def collect(self) -> pa.Table:

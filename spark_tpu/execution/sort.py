@@ -51,15 +51,12 @@ def sort_key_operand(vec: Vec, ascending: bool):
     return data
 
 
-def sort_permutation(batch: Batch, orders: Sequence[SortOrder]):
-    """Returns (perm, num_valid): perm puts rows in order with unselected
-    rows last; gathering all columns by perm and selecting iota<num_valid
-    yields the sorted, compacted batch."""
-    cap = batch.capacity
-    sel = batch.selection
+def sort_operands(batch: Batch, orders: Sequence[SortOrder]) -> List:
+    """Ascending-comparable operand arrays for the sort keys (null-rank
+    int8 columns interleaved before nullable keys). Comparing two rows'
+    operand tuples lexicographically == comparing them under `orders` —
+    shared by the local sort and the range-partitioning exchange."""
     operands = []
-    invalid = jnp.zeros((cap,), jnp.int8) if sel is None else (~sel).astype(jnp.int8)
-    operands.append(invalid)
     for o in orders:
         vec = o.eval(batch)
         if vec.validity is not None:
@@ -68,6 +65,17 @@ def sort_permutation(batch: Batch, orders: Sequence[SortOrder]):
             rank = nulls if not o.nulls_first else (1 - nulls)
             operands.append(rank.astype(jnp.int8))
         operands.append(sort_key_operand(vec, o.ascending))
+    return operands
+
+
+def sort_permutation(batch: Batch, orders: Sequence[SortOrder]):
+    """Returns (perm, num_valid): perm puts rows in order with unselected
+    rows last; gathering all columns by perm and selecting iota<num_valid
+    yields the sorted, compacted batch."""
+    cap = batch.capacity
+    sel = batch.selection
+    invalid = jnp.zeros((cap,), jnp.int8) if sel is None else (~sel).astype(jnp.int8)
+    operands = [invalid] + sort_operands(batch, orders)
     num_keys = len(operands)
     operands.append(jnp.arange(cap, dtype=jnp.int32))
     sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)

@@ -218,9 +218,15 @@ class ColumnRef(Expression):
         return self._name
 
 
+# session-level resolution mode, set from spark_tpu.sql.caseSensitive by
+# the executor before analysis/tracing (the driver is single-threaded,
+# matching the reference's thread-inheritable SQLConf activation)
+CASE_SENSITIVE = False
+
+
 def _resolve_field(schema: T.Schema, name: str) -> T.Field:
     matches = [f for f in schema.fields if f.name == name]
-    if not matches:
+    if not matches and not CASE_SENSITIVE:
         matches = [f for f in schema.fields if f.name.lower() == name.lower()]
     if not matches:
         raise AnalysisError(
@@ -233,9 +239,10 @@ def _resolve_field(schema: T.Schema, name: str) -> T.Field:
 def _resolve_column(batch: Batch, name: str) -> Column:
     if name in batch.columns:
         return batch.columns[name]
-    for n, c in batch.columns.items():
-        if n.lower() == name.lower():
-            return c
+    if not CASE_SENSITIVE:
+        for n, c in batch.columns.items():
+            if n.lower() == name.lower():
+                return c
     raise AnalysisError(f"column {name!r} not found among {batch.names}")
 
 

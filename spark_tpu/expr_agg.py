@@ -101,6 +101,16 @@ class AggregateFunction:
     def alias(self, name: str) -> "AggExpr":
         return AggExpr(self, name)
 
+    def over(self, spec) -> "Expression":
+        """sum(x).over(Window.partitionBy(...)) — turn this aggregate into
+        a window expression (pyspark's Column.over)."""
+        from .window import AGG_WINDOW_KINDS, WindowExpr
+        kind = AGG_WINDOW_KINDS.get(type(self).__name__)
+        if kind is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} is not supported over a window")
+        return WindowExpr(kind, self.child, spec)
+
     def _eval_child(self, batch: Batch, sel) -> Tuple[Vec, object]:
         v = self.child.eval(batch)
         m = sel
@@ -181,6 +191,20 @@ class Sum(AggregateFunction):
         return total, cnt > 0
 
 
+def decimal_avg_halfup(total, safe_cnt, extra: int):
+    """Traced exact integer HALF_UP of (total * extra) / cnt, split as
+    q*extra + round(r*extra/cnt) so intermediates stay in int64 (shared
+    by Avg.device_finalize and windowed averages)."""
+    extra = jnp.int64(extra)
+    safe = safe_cnt.astype(jnp.int64)
+    absn = jnp.abs(total)
+    q0 = absn // safe
+    r0 = absn - q0 * safe
+    frac = (r0 * extra + safe // 2) // safe  # HALF_UP
+    mag = q0 * extra + frac
+    return jnp.where(total < 0, -mag, mag)
+
+
 class Avg(AggregateFunction):
     def result_type(self, schema):
         dt = self.child.dtype(schema)
@@ -221,20 +245,14 @@ class Avg(AggregateFunction):
     def device_finalize(self, accs, schema):
         dt = self.child.dtype(schema)
         if isinstance(dt, T.DecimalType):
-            # exact integer HALF_UP, matching the host `finalize` digit for
-            # digit (the former float64 round-trip diverged in the last
-            # digit — and TPU f64 is emulated, compounding it). Split as
-            # q*extra + round(r*extra/cnt) so intermediates stay in int64.
+            # exact integer HALF_UP, matching the host `finalize` digit
+            # for digit (the former float64 round-trip diverged in the
+            # last digit — and TPU f64 is emulated, compounding it)
             total, cnt = accs
             out_dt = self.result_type(schema)
-            extra = jnp.int64(10 ** (out_dt.scale - dt.scale))
-            safe = jnp.where(cnt > 0, cnt, 1).astype(jnp.int64)
-            absn = jnp.abs(total)
-            q0 = absn // safe
-            r0 = absn - q0 * safe
-            frac = (r0 * extra + safe // 2) // safe  # HALF_UP
-            mag = q0 * extra + frac
-            return jnp.where(total < 0, -mag, mag), cnt > 0
+            safe = jnp.where(cnt > 0, cnt, 1)
+            return decimal_avg_halfup(
+                total, safe, 10 ** (out_dt.scale - dt.scale)), cnt > 0
         total, cnt = accs
         safe = jnp.where(cnt > 0, cnt, 1)
         return (total / safe).astype(jnp.float64), cnt > 0

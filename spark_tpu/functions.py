@@ -150,6 +150,32 @@ def eq_null_safe(a, b) -> EqNullSafe:
     return EqNullSafe(_expr(a), _expr(b))
 
 
+# window functions (spark_tpu.window has the Window/WindowSpec builders)
+def row_number():
+    from .window import row_number as f
+    return f()
+
+
+def rank():
+    from .window import rank as f
+    return f()
+
+
+def dense_rank():
+    from .window import dense_rank as f
+    return f()
+
+
+def lag(e, offset: int = 1, default=None):
+    from .window import lag as f
+    return f(e, offset, default)
+
+
+def lead(e, offset: int = 1, default=None):
+    from .window import lead as f
+    return f(e, offset, default)
+
+
 def pmod(dividend, divisor) -> Expression:
     """Positive modulo: result in [0, |divisor|) (reference: pmod())."""
     from .expr import Pmod

@@ -132,16 +132,25 @@ def exchange_hash(batch: Batch, key_names: Sequence[str], ctx,
     executor's stats->re-plan loop re-jits with a sufficient capacity when
     skew overflows it — the AQE pattern joins already use."""
     n = ctx.n_shards
-    axis = ctx.axis_name
     L = batch.capacity
     if block_cap is None:
         from ..columnar import bucket_capacity
         block_cap = min(L, bucket_capacity(-(-2 * L // n)))  # ceil(2L/n)
-    block = block_cap
     sel = batch.selection_mask()
     h = hash_rows(batch, key_names)
     tgt = (h.astype(jnp.uint64) % np.uint64(n)).astype(jnp.int32)
     tgt = jnp.where(sel, tgt, n)  # dead rows dropped
+    return _exchange_by_target(batch, tgt, ctx, block_cap, tag)
+
+
+def _exchange_by_target(batch: Batch, tgt, ctx, block: int,
+                        tag: str) -> Batch:
+    """Route each selected row to shard `tgt[row]` via scatter +
+    all_to_all; surfaces the max per-bucket count for the executor's
+    capacity-retry loop."""
+    n = ctx.n_shards
+    axis = ctx.axis_name
+    sel = batch.selection_mask()
     flat, perm, max_count = _scatter_to_buckets(batch, tgt, n, block)
     ctx.add_metric(f"exch_max_{tag}", max_count)
     ctx.add_flag(f"exch_overflow_{tag}", max_count > block)
@@ -153,8 +162,8 @@ def exchange_hash(batch: Batch, key_names: Sequence[str], ctx,
         return jax.lax.all_to_all(send.reshape(n, block), axis, 0, 0
                                   ).reshape(n * block)
 
-    live = send_recv(sel & (tgt >= 0), fill=False)  # scattered True marks
-    # NOTE: `sel & (tgt>=0)` == sel; dead rows never scatter (flat OOB)
+    live = send_recv(sel, fill=False)  # scattered True marks live rows
+    # (dead rows never scatter: their flat index is out of bounds)
     cols: Dict[str, Column] = {}
     for name, col in batch.columns.items():
         data = send_recv(col.data)
@@ -162,6 +171,69 @@ def exchange_hash(batch: Batch, key_names: Sequence[str], ctx,
             col.validity, fill=False)
         cols[name] = Column(data, col.dtype, validity, col.dictionary)
     return Batch(cols, live)
+
+
+RANGE_SAMPLES_PER_SHARD = 64
+
+
+def exchange_range(batch: Batch, orders, ctx,
+                   block_cap: Optional[int] = None,
+                   tag: str = "e0") -> Batch:
+    """RangePartitioning exchange: sampled bounds + all_to_all.
+
+    The distributed global-sort layout (reference: `Partitioner.scala:140`
+    RangePartitioner + `partitioning.scala:255`): each shard contributes a
+    strided sample of its sort-key tuples; samples are all_gather'ed
+    (tiny — n*64 rows), sorted identically on every shard, and n-1
+    quantile bounds picked; rows route to the shard whose key range holds
+    them (lexicographic compare against the bounds). Shard i then holds
+    keys <= shard i+1's, so locally sorted shards concatenate into the
+    globally sorted result — no shard ever materializes the full dataset
+    (the round-2 design all_gather'ed everything to every shard).
+    Sampling skew only unbalances bucket sizes; the exch_overflow retry
+    loop keeps it correct."""
+    from ..execution.sort import sort_operands
+    n = ctx.n_shards
+    axis = ctx.axis_name
+    L = batch.capacity
+    if block_cap is None:
+        from ..columnar import bucket_capacity
+        block_cap = min(L, bucket_capacity(-(-2 * L // n)))
+    sel = batch.selection_mask()
+    ops = sort_operands(batch, orders)
+
+    s = min(RANGE_SAMPLES_PER_SHARD, L)
+    pos = (jnp.arange(s, dtype=jnp.int32) * (L // s)) if s else \
+        jnp.zeros((0,), jnp.int32)
+    samp_invalid = ~jnp.take(sel, pos)
+    samp_ops = [jnp.take(op, pos) for op in ops]
+
+    def gather(x):
+        return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+
+    g_invalid = gather(samp_invalid)          # [n*s]
+    g_ops = [gather(op) for op in samp_ops]
+    # identical sort on every shard: invalid samples last
+    sorted_samples = jax.lax.sort(
+        tuple([g_invalid.astype(jnp.int8)] + g_ops),
+        num_keys=1 + len(g_ops))
+    total_valid = jnp.sum((~g_invalid).astype(jnp.int32))
+    # n-1 quantile positions among the valid prefix
+    qpos = (jnp.arange(1, n, dtype=jnp.int32) * total_valid) // n
+    bounds = [jnp.take(op_s, qpos) for op_s in sorted_samples[1:]]
+
+    # target shard = number of bounds strictly below the row's key tuple
+    tgt = jnp.zeros((L,), jnp.int32)
+    for b in range(n - 1):
+        gt = jnp.zeros((L,), jnp.bool_)
+        eq = jnp.ones((L,), jnp.bool_)
+        for op, bound in zip(ops, bounds):
+            bv = bound[b]
+            gt = gt | (eq & (op > bv))
+            eq = eq & (op == bv)
+        tgt = tgt + gt.astype(jnp.int32)
+    tgt = jnp.where(sel, tgt, n)
+    return _exchange_by_target(batch, tgt, ctx, block_cap, tag)
 
 
 def all_gather_batch(batch: Batch, ctx) -> Batch:

@@ -261,6 +261,44 @@ class Join(LogicalPlan):
                 + ")")
 
 
+class WindowPlan(LogicalPlan):
+    """Append window-function columns over ONE shared (partition, order)
+    spec (reference: logical Window in basicLogicalOperators.scala;
+    different specs become separate nodes)."""
+
+    def __init__(self, child: LogicalPlan, wexprs: Sequence[Tuple]):
+        # wexprs: (WindowExpr, out_name) pairs sharing one spec
+        from ..window import WindowExpr
+        if not wexprs:
+            raise AnalysisError("Window requires at least one function")
+        spec0 = wexprs[0][0].spec
+        for w, _ in wexprs:
+            if not isinstance(w, WindowExpr):
+                raise AnalysisError(f"not a window expression: {w!r}")
+            if (tuple(repr(p) for p in w.spec._partition)
+                    != tuple(repr(p) for p in spec0._partition)
+                    or tuple(repr(o) for o in w.spec._order)
+                    != tuple(repr(o) for o in spec0._order)):
+                raise AnalysisError(
+                    "one Window node requires a shared window spec")
+        self.children = (child,)
+        self.wexprs = tuple(wexprs)
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def schema(self) -> T.Schema:
+        cs = self.child.schema()
+        fields = list(cs.fields)
+        for w, name in self.wexprs:
+            fields.append(T.Field(name, w.dtype(cs), w.nullable(cs)))
+        return T.Schema(fields)
+
+    def simple_string(self):
+        return f"Window({[(repr(w), n) for w, n in self.wexprs]!r})"
+
+
 class Sort(LogicalPlan):
     def __init__(self, child: LogicalPlan, orders: Sequence[SortOrder]):
         self.children = (child,)

@@ -118,7 +118,14 @@ class PruneColumns(Rule):
             avail = node.source.schema().names
             for f in node.pushed_filters:
                 needed = needed | f.references()
-            cols = tuple(n for n in avail if n in needed)
+            from ..expr import CASE_SENSITIVE
+            if CASE_SENSITIVE:
+                cols = tuple(n for n in avail if n in needed)
+            else:
+                # match the engine's case-insensitive resolution — a
+                # reference spelled 'mixed' must keep column 'Mixed'
+                lowered = {n.lower() for n in needed}
+                cols = tuple(n for n in avail if n.lower() in lowered)
             if node.required_columns is not None and \
                     set(node.required_columns) == set(cols):
                 return node
@@ -220,6 +227,60 @@ class ConstantFolding(Rule):
         return plan.transform_up(f)
 
 
+class CollapseProjectIntoAggregate(Rule):
+    """Aggregate over Project -> Aggregate with the projected expressions
+    inlined (reference: CollapseProject). Besides removing a pass, this
+    lets `key_domain` see through `(id % N) AS k` aliases, keeping the
+    dense-domain MXU aggregate path that a bare ColumnRef group key
+    would miss (the sort path is ~30x slower at bench shapes)."""
+
+    name = "CollapseProjectIntoAggregate"
+
+    def apply(self, plan):
+        def f(node):
+            if not (isinstance(node, Aggregate)
+                    and isinstance(node.child, Project)):
+                return node
+            proj = node.child
+            mapping = {}
+            for e in proj.exprs:
+                if isinstance(e, Alias):
+                    mapping[e.name()] = e.child
+                elif isinstance(e, ColumnRef):
+                    mapping[e.name()] = e
+
+            def subst(e: Expression) -> Expression:
+                out = _substitute(e, mapping)
+                # every reference must resolve below the projection
+                try:
+                    out.dtype(proj.child.schema())
+                except Exception:
+                    return None
+                return out
+
+            new_groups = []
+            for g in node.group_exprs:
+                s = subst(g.child if isinstance(g, Alias) else g)
+                if s is None:
+                    return node
+                new_groups.append(Alias(s, g.name()))
+            import copy
+            new_aggs = []
+            for a in node.agg_exprs:
+                func = a.func
+                if func.child is not None:
+                    s = subst(func.child)
+                    if s is None:
+                        return node
+                    func = copy.copy(func)
+                    func.child = s
+                    func.children = (s,)
+                new_aggs.append(type(a)(func, a.out_name))
+            return Aggregate(proj.child, new_groups, new_aggs)
+
+        return plan.transform_up(f)
+
+
 class RewriteDistinctAggregates(Rule):
     """count(DISTINCT x) -> count(x) over a (groups, x) dedupe aggregate —
     the single-distinct case of the reference's
@@ -269,6 +330,7 @@ def default_optimizer() -> RuleExecutor:
             PushFilterThroughProject(),
             PushFilterIntoScan(),
         ]),
+        Batch("Collapse", [CollapseProjectIntoAggregate()]),
         Batch("Fold", [ConstantFolding()], strategy="once"),
         Batch("Prune", [PruneColumns()], strategy="once"),
     ])

@@ -12,6 +12,7 @@ role of `WholeStageCodegenExec.scala:626`.
 from __future__ import annotations
 
 import copy
+import dataclasses
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -57,6 +58,14 @@ class BroadcastDistribution(Distribution):
     """Full copy on every shard."""
 
 
+@dataclass(frozen=True)
+class OrderedDistribution(Distribution):
+    """Rows range-partitioned by sort key: shard i's keys all <= shard
+    i+1's (reference: OrderedDistribution in partitioning.scala:79)."""
+
+    order_key: Tuple[str, ...]  # repr of the SortOrders (equality basis)
+
+
 class Partitioning:
     num_partitions: int = 1
 
@@ -93,6 +102,25 @@ class Replicated(Partitioning):
 
     def satisfies(self, dist):
         return isinstance(dist, (UnspecifiedDistribution, BroadcastDistribution))
+
+
+@dataclass(frozen=True)
+class RangePartitioning(Partitioning):
+    """Contiguous key ranges over the mesh axis in shard order
+    (reference: RangePartitioning, partitioning.scala:255). `orders`
+    carries the actual SortOrder objects for the exchange lowering;
+    equality/hashing uses their repr (SortOrder overloads no __eq__)."""
+
+    order_key: Tuple[str, ...] = ()
+    num_partitions: int = 1
+    orders: Tuple = dataclasses.field(default=(), compare=False, hash=False)
+
+    def satisfies(self, dist):
+        if isinstance(dist, UnspecifiedDistribution):
+            return True
+        if isinstance(dist, OrderedDistribution):
+            return self.order_key == dist.order_key
+        return False
 
 
 @dataclass(frozen=True)
@@ -360,6 +388,7 @@ class HashAggregateExec(UnaryExec):
         self.agg_exprs = tuple(agg_exprs)
         self.mode = mode
         self.est_groups = est_groups
+        self.tag = "a0"
 
     def _child_schema_for_types(self) -> T.Schema:
         cs = self.child.schema()
@@ -434,9 +463,20 @@ class HashAggregateExec(UnaryExec):
                 key_vecs, domains, contribs, specs, sel)
             key_valids = [None] * len(key_arrays)
         else:
-            key_arrays, key_valids, accs, occupied = agg_kernels.sort_aggregate(
+            num_segments = batch.capacity
+            if self.est_groups and self.group_exprs:
+                num_segments = min(batch.capacity,
+                                   bucket_capacity(self.est_groups))
+            (key_arrays, key_valids, accs, occupied,
+             total_groups) = agg_kernels.sort_aggregate(
                 key_vecs, contribs, specs, sel, batch.capacity,
-                num_segments=self.est_groups and bucket_capacity(self.est_groups))
+                num_segments=num_segments)
+            if num_segments < batch.capacity:
+                # sized-down output: surface the real group count so the
+                # executor can re-jit bigger on overflow (AQE loop)
+                ctx.add_metric(f"agg_groups_{self.tag}", total_groups)
+                ctx.add_flag(f"agg_overflow_{self.tag}",
+                             total_groups > num_segments)
 
         if not self.group_exprs:
             # global aggregate: exactly one output row, always present
@@ -549,7 +589,8 @@ class HashAggregateExec(UnaryExec):
     def simple_string(self):
         return (f"HashAggregateExec(mode={self.mode}, "
                 f"groups={[repr(g) for g in self.group_exprs]}, "
-                f"aggs={[repr(a) for a in self.agg_exprs]})")
+                f"aggs={[repr(a) for a in self.agg_exprs]}, "
+                f"est={self.est_groups})")
 
 
 @dataclass
@@ -574,6 +615,12 @@ def _np_to_logical(np_dtype) -> T.DataType:
 
 
 class SortExec(UnaryExec):
+    """Global sort: range-partition over the mesh (sampled bounds +
+    all_to_all), then sort locally — shard i's keys <= shard i+1's, so
+    the ordered shard concat IS the global order (reference:
+    SortExec.scala:40 + RangePartitioning). Single-chip, the requirement
+    is trivially satisfied and this is just the local sort."""
+
     def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
         self.children = (child,)
         self.orders = tuple(orders)
@@ -581,10 +628,14 @@ class SortExec(UnaryExec):
     def schema(self):
         return self.child.schema()
 
+    def order_key(self) -> Tuple[str, ...]:
+        return tuple(repr(o) for o in self.orders)
+
     def required_child_distributions(self):
-        # global sort: all rows in one logical partition (range partitioning
-        # lands with the multi-chip exchange)
-        return [AllTuples()]
+        return [OrderedDistribution(self.order_key())]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
 
     def compute(self, ctx, inputs):
         batch = inputs[0]
@@ -595,7 +646,163 @@ class SortExec(UnaryExec):
         return f"SortExec({[repr(o) for o in self.orders]})"
 
 
+class WindowExec(UnaryExec):
+    """All window functions of one spec over one sorted permutation
+    (reference: execution/window/WindowExec.scala — frame processors
+    become segmented scans, execution/window.py). Partitions co-locate
+    via a hash exchange; an empty PARTITION BY needs all rows together."""
+
+    def __init__(self, child: PhysicalPlan, wexprs: Sequence[Tuple],
+                 out_schema: T.Schema):
+        self.children = (child,)
+        self.wexprs = tuple(wexprs)
+        self._schema = out_schema
+
+    def schema(self):
+        return self._schema
+
+    def _spec(self):
+        return self.wexprs[0][0].spec
+
+    def required_child_distributions(self):
+        from ..expr import ColumnRef
+        spec = self._spec()
+        if not spec._partition:
+            return [AllTuples()]
+        names = []
+        for p in spec._partition:
+            e = p
+            while isinstance(e, Alias):
+                e = e.child
+            if not isinstance(e, ColumnRef):
+                return [AllTuples()]
+            names.append(e.name())
+        return [ClusteredDistribution(tuple(names))]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
+
+    def compute(self, ctx, inputs):
+        from ..execution import window as win
+        from ..execution.sort import sort_operands
+        batch = inputs[0]
+        cap = batch.capacity
+        sel = batch.selection_mask()
+        spec = self._spec()
+
+        p_orders = [SortOrder(p, ascending=True) for p in spec._partition]
+        p_ops = sort_operands(batch, p_orders)
+        o_ops = sort_operands(batch, list(spec._order))
+
+        operands = [(~sel).astype(jnp.int8)] + p_ops + o_ops
+        num_keys = len(operands)
+        operands.append(jnp.arange(cap, dtype=jnp.int32))
+        sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
+        perm = sorted_ops[-1]
+        valid_sorted = sorted_ops[0] == 0
+        sp_ops = list(sorted_ops[1:1 + len(p_ops)])
+        so_ops = list(sorted_ops[1 + len(p_ops):num_keys])
+
+        starts = win._segment_starts(sp_ops, cap, valid_sorted)
+        gid = jnp.cumsum(starts.astype(jnp.int32)) - 1
+        gid = jnp.where(valid_sorted, gid, cap)
+        change = win._peer_change(starts, so_ops, cap)
+        base = self.child.schema()
+
+        new_cols: Dict[str, Column] = dict(batch.columns)
+        for w, name in self.wexprs:
+            out_dtype = w.dtype(base)
+            validity_sorted = None
+            if w.kind == "row_number":
+                vals = win.row_number(starts, cap)
+            elif w.kind == "rank":
+                vals = win.rank(starts, change, cap)
+            elif w.kind == "dense_rank":
+                vals = win.dense_rank(starts, change, cap)
+            elif w.kind in ("lag", "lead"):
+                v = w.arg.eval(batch)
+                if v.dictionary is not None and w.default is not None:
+                    raise AnalysisError(
+                        "lag/lead with a default on a string column is "
+                        "not supported (the default would be written in "
+                        "dictionary-code space)")
+                data_s = jnp.take(v.data, perm)
+                val_s = None if v.validity is None else \
+                    jnp.take(v.validity, perm)
+                vals, validity_sorted = win.shift_in_segment(
+                    data_s, val_s, gid, w.offset, w.default, cap)
+            else:
+                if w.arg is None:  # count(*) over (...)
+                    data_s = jnp.ones((cap,), jnp.int64)
+                    val_s = None
+                else:
+                    v = w.arg.eval(batch)
+                    if v.dictionary is not None and w.kind != "count":
+                        # codes are insertion-ordered, not lexicographic:
+                        # min/max/sum over codes would silently corrupt
+                        raise AnalysisError(
+                            f"window {w.kind} over a string column is "
+                            f"not supported")
+                    from ..expr import cast_vec
+                    acc_t = out_dtype if w.kind in ("sum",) else v.dtype
+                    if w.kind == "avg":
+                        from ..expr_agg import Sum
+                        acc_t = Sum(w.arg).result_type(base)
+                    vv = cast_vec(v, acc_t)
+                    data_s = jnp.take(vv.data, perm)
+                    val_s = None if vv.validity is None else \
+                        jnp.take(vv.validity, perm)
+                if val_s is not None:
+                    val_s = val_s & valid_sorted
+                else:
+                    val_s = valid_sorted
+                out, cnt = win.windowed_agg(
+                    "sum" if w.kind == "avg" else w.kind, data_s, val_s,
+                    gid, cap, starts, change, bool(spec._order), cap)
+                if w.kind == "avg":
+                    safe = jnp.maximum(cnt, 1)
+                    if isinstance(out_dtype, T.DecimalType):
+                        from ..expr_agg import decimal_avg_halfup
+                        arg_t = w.arg.dtype(base)
+                        vals = decimal_avg_halfup(
+                            out.astype(jnp.int64), safe,
+                            10 ** (out_dtype.scale - arg_t.scale))
+                    else:
+                        vals = out.astype(jnp.float64) / safe
+                elif w.kind == "count":
+                    vals = cnt
+                else:
+                    vals = out
+                if w.kind != "count":
+                    validity_sorted = cnt > 0
+            # scatter back to input row order
+            unsorted = jnp.zeros((cap,), vals.dtype).at[perm].set(vals)
+            validity = None
+            if validity_sorted is not None:
+                validity = jnp.zeros((cap,), jnp.bool_).at[perm].set(
+                    validity_sorted)
+            src_dict = None
+            if w.kind in ("lag", "lead"):
+                src = w.arg.eval(batch)
+                src_dict = src.dictionary
+            new_cols[name] = Column(unsorted.astype(out_dtype.np_dtype),
+                                    out_dtype, validity, src_dict)
+        return Batch(new_cols, batch.selection)
+
+    def simple_string(self):
+        # the FULL spec must be in the fingerprint: the compiled-stage
+        # cache keys on describe(), and two windows differing only in
+        # partition/order would otherwise collide
+        return f"WindowExec({[(repr(w), n) for w, n in self.wexprs]})"
+
+
 class LimitExec(UnaryExec):
+    """First-n. Over a range-partitioned (sorted) child it stays
+    distributed: shard i keeps rows whose global rank — its local rank
+    plus the psum'd count on lower shards — is under n, with no gather of
+    the dataset (reference: the GlobalLimit/LocalLimit split in
+    limit.scala). Otherwise it collapses to one logical partition."""
+
     def __init__(self, child: PhysicalPlan, n: int):
         self.children = (child,)
         self.n = n
@@ -604,12 +811,29 @@ class LimitExec(UnaryExec):
         return self.child.schema()
 
     def required_child_distributions(self):
+        if isinstance(self.child.output_partitioning(), RangePartitioning):
+            return [UnspecifiedDistribution()]
         return [AllTuples()]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
 
     def compute(self, ctx, inputs):
         batch = inputs[0]
         sel = batch.selection_mask()
-        keep = jnp.cumsum(sel.astype(jnp.int32)) <= self.n
+        local_rank = jnp.cumsum(sel.astype(jnp.int32)) - sel.astype(jnp.int32)
+        if ctx.axis_name is not None and ctx.n_shards > 1 and \
+                isinstance(self.child.output_partitioning(),
+                           RangePartitioning):
+            n_shards = ctx.n_shards
+            local_count = jnp.sum(sel.astype(jnp.int32))
+            counts = jax.lax.all_gather(local_count, ctx.axis_name)
+            i = jax.lax.axis_index(ctx.axis_name)
+            offset = jnp.sum(jnp.where(
+                jnp.arange(n_shards) < i, counts, 0))
+            keep = local_rank < jnp.maximum(self.n - offset, 0)
+            return batch.with_selection(sel & keep)
+        keep = local_rank < self.n
         return batch.with_selection(sel & keep)
 
     def simple_string(self):
@@ -1020,6 +1244,11 @@ class ExchangeExec(UnaryExec):
             return shuffle.exchange_hash(inputs[0], self.partitioning.keys,
                                          ctx, block_cap=self.block_cap,
                                          tag=self.tag)
+        if isinstance(self.partitioning, RangePartitioning):
+            return shuffle.exchange_range(inputs[0],
+                                          self.partitioning.orders, ctx,
+                                          block_cap=self.block_cap,
+                                          tag=self.tag)
         if isinstance(self.partitioning, (SinglePartition, Replicated)):
             return shuffle.all_gather_batch(inputs[0], ctx)
         raise AnalysisError(

@@ -39,6 +39,9 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
     counter = [0]
     ex_counter = [0]
 
+    agg_counter = [0]
+    op_counter = [0]
+
     def walk(node):
         for c in node.children:
             walk(c)
@@ -48,6 +51,11 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
         elif isinstance(node, P.ExchangeExec):
             node.tag = f"e{ex_counter[0]}"
             ex_counter[0] += 1
+        elif isinstance(node, P.HashAggregateExec):
+            node.tag = f"a{agg_counter[0]}"
+            agg_counter[0] += 1
+        node.op_tag = f"op{op_counter[0]}"
+        op_counter[0] += 1
 
     walk(plan)
 
@@ -112,22 +120,34 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
         return P.FilterExec(_convert(plan.child, conf, n), plan.condition)
     if isinstance(plan, L.Aggregate):
         child = _convert(plan.child, conf, n)
+        # size the sort-path output table from the estimate registry
+        # instead of the full input capacity (round-2 dead conf, now
+        # load-bearing; overflow re-jits via the agg_overflow flag)
+        est = int(conf.get("spark_tpu.sql.aggregate.estimatedGroups"))
+        rows = estimate_rows(plan.child)
+        if rows is not None:
+            est = min(est, max(1, rows))
         if n <= 1:
             return P.HashAggregateExec(child, plan.group_exprs,
-                                       plan.agg_exprs, mode="complete")
+                                       plan.agg_exprs, mode="complete",
+                                       est_groups=est)
         # two-phase: per-shard partial tables, exchange by group key (or
         # collapse to every shard for global aggregates), final re-reduce
         partial = P.HashAggregateExec(child, plan.group_exprs,
-                                      plan.agg_exprs, mode="partial")
+                                      plan.agg_exprs, mode="partial",
+                                      est_groups=est)
         final_groups = [ColumnRef(g.name()) for g in plan.group_exprs]
         return P.HashAggregateExec(partial, final_groups, plan.agg_exprs,
-                                   mode="final")
+                                   mode="final", est_groups=est)
     if isinstance(plan, L.Join):
         strategy = _pick_join_strategy(plan, conf, n)
         return P.JoinExec(_convert(plan.left, conf, n),
                           _convert(plan.right, conf, n),
                           plan.left_keys, plan.right_keys, plan.how,
                           plan.condition, plan.schema(), strategy=strategy)
+    if isinstance(plan, L.WindowPlan):
+        return P.WindowExec(_convert(plan.child, conf, n), plan.wexprs,
+                            plan.schema())
     if isinstance(plan, L.Sort):
         return P.SortExec(_convert(plan.child, conf, n), plan.orders)
     if isinstance(plan, L.Limit):
@@ -198,6 +218,10 @@ def ensure_requirements(plan: P.PhysicalPlan, conf: Conf,
         if isinstance(dist, P.ClusteredDistribution):
             fixed.append(P.ExchangeExec(
                 child, P.HashPartitioning(dist.keys, parts)))
+        elif isinstance(dist, P.OrderedDistribution):
+            fixed.append(P.ExchangeExec(
+                child, P.RangePartitioning(dist.order_key, parts,
+                                           orders=plan.orders)))
         elif isinstance(dist, P.AllTuples):
             fixed.append(P.ExchangeExec(child, P.SinglePartition()))
         elif isinstance(dist, P.BroadcastDistribution):

@@ -461,7 +461,13 @@ class Parser:
             raise ParseError(f"unexpected keyword {t.value!r} at {t.pos}")
         # function call or (qualified) column reference
         if self.peek(1).kind == "op" and self.peek(1).value == "(":
-            return self.parse_function()
+            e = self.parse_function()
+            if self.at_kw("OVER"):
+                return self._parse_over(e)
+            if isinstance(e, _RankingCall):
+                raise ParseError(
+                    f"{e.kind}() requires an OVER (...) clause")
+            return e
         self.next()
         name = t.value
         if self.at_op(".") and self.peek(1).kind == "ident":
@@ -553,6 +559,27 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return _AggCall(self._AGGS[name](e))
+        if name in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
+            self.expect_op(")")
+            return _RankingCall(name.lower(), None, 0, None)
+        if name in ("LAG", "LEAD"):
+            arg = self.parse_expr()
+            offset, default = 1, None
+            if self.eat_op(","):
+                off = self.parse_expr()
+                if not (isinstance(off, Literal)
+                        and isinstance(off.value, int)):
+                    raise ParseError(f"{name} offset must be a literal int")
+                offset = off.value
+                if self.eat_op(","):
+                    dflt = self.parse_expr()
+                    if not isinstance(dflt, Literal):
+                        raise ParseError(f"{name} default must be a literal")
+                    default = dflt.value
+            self.expect_op(")")
+            return _RankingCall(name.lower(), arg,
+                                offset if name == "LAG" else -offset,
+                                default)
         args: List[Expression] = []
         if not self.at_op(")"):
             args.append(self.parse_expr())
@@ -560,6 +587,45 @@ class Parser:
                 args.append(self.parse_expr())
         self.expect_op(")")
         return self._scalar_function(name, args)
+
+    def _parse_over(self, call: Expression) -> Expression:
+        """fn(...) OVER ([PARTITION BY ...] [ORDER BY ...])."""
+        from ..window import WindowExpr, WindowSpec
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition: List[Expression] = []
+        order: List[SortOrder] = []
+        if self.at_kw("PARTITION"):
+            self.next()
+            self.expect_kw("BY")
+            partition.append(self.parse_expr())
+            while self.eat_op(","):
+                partition.append(self.parse_expr())
+        if self.at_kw("ORDER"):
+            self.next()
+            self.expect_kw("BY")
+            while True:
+                e, asc, nf = self.parse_sort_item()
+                order.append(SortOrder(e, ascending=asc, nulls_first=nf))
+                if not self.eat_op(","):
+                    break
+        self.expect_op(")")
+        spec = WindowSpec(tuple(partition), tuple(order))
+        if isinstance(call, _RankingCall):
+            if not order:
+                raise ParseError(
+                    f"{call.kind}() requires ORDER BY in its OVER clause")
+            return WindowExpr(call.kind, call.arg, spec,
+                              offset=call.offset, default=call.default)
+        if isinstance(call, _AggCall):
+            from ..window import AGG_WINDOW_KINDS
+            kind = AGG_WINDOW_KINDS.get(type(call.func).__name__)
+            if kind is None:
+                raise ParseError(
+                    f"{type(call.func).__name__} is not supported as a "
+                    f"window function")
+            return WindowExpr(kind, call.func.child, spec)
+        raise ParseError("OVER applies to window or aggregate functions")
 
     def _scalar_function(self, name: str, args: List[Expression]) -> Expression:
         if name == "YEAR" and len(args) == 1:
@@ -589,6 +655,21 @@ class Parser:
         if name == "COALESCE":
             return Coalesce(*args)
         raise ParseError(f"unknown function {name!r}")
+
+
+class _RankingCall(Expression):
+    """Parse-time sentinel for row_number/rank/dense_rank/lag/lead —
+    only valid immediately followed by OVER."""
+
+    def __init__(self, kind: str, arg, offset: int, default):
+        self.kind = kind
+        self.arg = arg
+        self.offset = offset
+        self.default = default
+        self.children = () if arg is None else (arg,)
+
+    def dtype(self, schema):
+        raise AnalysisError(f"{self.kind}() requires an OVER clause")
 
 
 class _QualifiedRef(Expression):
@@ -1002,6 +1083,15 @@ class Lowerer:
             sel.group_by is not None or \
             (sel.having is not None and _contains_agg(sel.having))
 
+        from ..window import contains_window
+        has_window = any(contains_window(e) for e, _ in items)
+        if has_window:
+            if has_agg:
+                raise AnalysisError(
+                    "window functions with GROUP BY/aggregates in one "
+                    "SELECT are not supported yet (use a FROM subquery)")
+            plan, items = self._extract_window_items(plan, items)
+
         if sel.distinct and has_agg:
             raise AnalysisError(
                 "SELECT DISTINCT with aggregates is not supported yet")
@@ -1142,6 +1232,21 @@ class Lowerer:
             plan = L.Filter(plan, having_expr)
         plan = L.Project(plan, post)
         return self._lower_order_limit(sel, plan)
+
+    def _extract_window_items(self, plan: L.LogicalPlan, items):
+        """Pull WindowExpr nodes into Window plan nodes below the
+        projection (shared with the DataFrame layer: one node — one
+        sort — per distinct spec; collision-safe names)."""
+        from ..window import extract_window_exprs
+        exprs = [Alias(e, a) if a else e for e, a in items]
+        plan, out = extract_window_exprs(plan, exprs)
+        rebuilt = []
+        for (orig_e, a), new_e in zip(items, out):
+            if a and isinstance(new_e, Alias):
+                rebuilt.append((new_e.child, a))
+            else:
+                rebuilt.append((new_e, a))
+        return plan, rebuilt
 
     def _lower_order_limit(self, sel: _Select, plan: L.LogicalPlan,
                            key_rewrite=None) -> L.LogicalPlan:

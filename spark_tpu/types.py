@@ -107,9 +107,9 @@ class DecimalType(FractionalType):
     value = unscaled / 10**scale. Addition/subtraction are exact; a
     multiply of (p1,s1)x(p2,s2) yields scale s1+s2 (rescaled by the
     expression layer); division promotes to float64. Precision is tracked
-    for schema fidelity but int64 range (~9.2e18) is the true bound —
-    overflow behavior follows ANSI_ENABLED like the reference's
-    `Decimal.scala`.
+    for schema fidelity but int64 range (~9.2e18) is the true bound;
+    out-of-range arithmetic wraps (no configurable ANSI error mode —
+    unlike the reference's `Decimal.scala`).
     """
 
     precision: int = 38

@@ -318,3 +318,62 @@ def test_distributed_union_mixed_partitioning(session):
         return sorted_a.union(session.create_dataframe(b, "mub"))
 
     _parity(session, build, ["k"])
+
+
+def test_distributed_range_sort(session):
+    """Global sort = sampled range bounds + all_to_all + local sort —
+    no full-dataset all_gather (round-2 weak #5)."""
+    rs = np.random.RandomState(3)
+    pdf = pd.DataFrame({"k": rs.randint(-1000, 1000, 5000).astype(np.int64),
+                        "v": np.arange(5000, dtype=np.int64)})
+
+    def build():
+        return session.create_dataframe(pdf, "rsort").sort(
+            col("k"), col("v"))
+
+    session.conf.set(MESH_KEY, 8)
+    try:
+        got = build().to_pandas()
+        plan = build()._qe().executed_plan.tree_string()
+    finally:
+        session.conf.set(MESH_KEY, 0)
+    assert "RangePartitioning" in plan, plan
+    want = pdf.sort_values(["k", "v"]).reset_index(drop=True)
+    # exact ORDER matters here (not just set equality)
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["v"].tolist() == want["v"].tolist()
+
+
+def test_distributed_sort_desc_limit(session):
+    rs = np.random.RandomState(4)
+    pdf = pd.DataFrame({"k": rs.randint(0, 10**9, 3000).astype(np.int64)})
+
+    def build():
+        return session.create_dataframe(pdf, "rsl").sort(
+            col("k").desc()).limit(7)
+
+    session.conf.set(MESH_KEY, 8)
+    try:
+        got = build().to_pandas()
+    finally:
+        session.conf.set(MESH_KEY, 0)
+    want = pdf.sort_values("k", ascending=False).head(7)
+    assert got["k"].tolist() == want["k"].tolist()
+
+
+def test_distributed_sort_skewed_keys(session):
+    """Heavily skewed sort keys overflow the sampled buckets and must be
+    recovered by the exchange retry loop."""
+    pdf = pd.DataFrame({"k": np.concatenate([
+        np.zeros(2500, dtype=np.int64),
+        np.arange(100, dtype=np.int64) + 1])})
+
+    def build():
+        return session.create_dataframe(pdf, "rskew").sort(col("k"))
+
+    session.conf.set(MESH_KEY, 8)
+    try:
+        got = build().to_pandas()
+    finally:
+        session.conf.set(MESH_KEY, 0)
+    assert got["k"].tolist() == sorted(pdf["k"].tolist())

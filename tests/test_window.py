@@ -1,0 +1,155 @@
+"""Window function parity vs pandas (reference:
+`execution/window/WindowExec.scala` semantics — Spark default RANGE
+frame for ordered aggregates, peers included)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+from spark_tpu.window import Window
+
+MESH_KEY = "spark_tpu.sql.mesh.size"
+
+
+@pytest.fixture(scope="module")
+def wdata(session):
+    rs = np.random.RandomState(11)
+    pdf = pd.DataFrame({
+        "g": rs.randint(0, 6, 200).astype(np.int64),
+        "o": rs.randint(0, 50, 200).astype(np.int64),  # has ties
+        "v": rs.randint(-100, 100, 200).astype(np.int64),
+    })
+    session.register_table("wdata", pdf)
+    return session, pdf
+
+
+def test_row_number(wdata):
+    session, pdf = wdata
+    w = Window.partition_by(col("g")).order_by(col("o"), col("v"))
+    got = (session.table("wdata")
+           .with_column("rn", F.row_number().over(w))
+           .to_pandas())
+    want = (pdf.sort_values(["o", "v"]).groupby("g").cumcount() + 1)
+    assert got["rn"].tolist() == want.sort_index().tolist()
+
+
+def test_rank_dense_rank(wdata):
+    session, pdf = wdata
+    w = Window.partition_by(col("g")).order_by(col("o"))
+    got = (session.table("wdata")
+           .with_column("r", F.rank().over(w))
+           .with_column("dr", F.dense_rank().over(w))
+           .to_pandas())
+    want_r = pdf.groupby("g")["o"].rank(method="min").astype(int)
+    want_dr = pdf.groupby("g")["o"].rank(method="dense").astype(int)
+    assert got["r"].tolist() == want_r.tolist()
+    assert got["dr"].tolist() == want_dr.tolist()
+
+
+def test_lag_lead(wdata):
+    session, pdf = wdata
+    w = Window.partition_by(col("g")).order_by(col("o"), col("v"))
+    got = (session.table("wdata")
+           .with_column("lg", F.lag(col("v")).over(w))
+           .with_column("ld", F.lead(col("v"), 2).over(w))
+           .to_pandas())
+    s = pdf.sort_values(["g", "o", "v"], kind="stable")
+    want_lg = s.groupby("g")["v"].shift(1).sort_index()
+    want_ld = s.groupby("g")["v"].shift(-2).sort_index()
+    assert np.array_equal(got["lg"].fillna(-9999).to_numpy(),
+                          want_lg.fillna(-9999).to_numpy())
+    assert np.array_equal(got["ld"].fillna(-9999).to_numpy(),
+                          want_ld.fillna(-9999).to_numpy())
+
+
+def test_sum_over_whole_partition(wdata):
+    session, pdf = wdata
+    w = Window.partition_by(col("g"))
+    got = (session.table("wdata")
+           .with_column("sv", F.sum(col("v")).over(w))
+           .with_column("cv", F.count(col("v")).over(w))
+           .with_column("mx", F.max(col("v")).over(w))
+           .to_pandas())
+    want = pdf.groupby("g")["v"]
+    assert got["sv"].tolist() == want.transform("sum").tolist()
+    assert got["cv"].tolist() == want.transform("count").tolist()
+    assert got["mx"].tolist() == want.transform("max").tolist()
+
+
+def test_running_sum_range_frame(wdata):
+    """Spark default frame with ORDER BY: RANGE UNBOUNDED PRECEDING ..
+    CURRENT ROW — peer rows (order-key ties) are included."""
+    session, pdf = wdata
+    w = Window.partition_by(col("g")).order_by(col("o"))
+    got = (session.table("wdata")
+           .with_column("rs", F.sum(col("v")).over(w))
+           .to_pandas())
+    # pandas equivalent: group by (g, o) sums, cumsum within g, mapped
+    # back to every row (ties share the value)
+    per_o = pdf.groupby(["g", "o"])["v"].sum().groupby(level=0).cumsum()
+    want = pdf.set_index(["g", "o"]).index.map(per_o)
+    assert got["rs"].tolist() == list(want)
+
+
+def test_global_window_no_partition(wdata):
+    session, pdf = wdata
+    w = Window.order_by(col("o"), col("v"))
+    got = (session.table("wdata")
+           .with_column("rn", F.row_number().over(w))
+           .to_pandas())
+    want = (pdf.sort_values(["o", "v"], kind="stable")
+            .reset_index().sort_values("index").index + 1)
+    s = pdf.sort_values(["o", "v"], kind="stable")
+    rn = pd.Series(np.arange(1, len(s) + 1), index=s.index).sort_index()
+    assert got["rn"].tolist() == rn.tolist()
+
+
+def test_window_distributed(wdata):
+    session, pdf = wdata
+    w = Window.partition_by(col("g")).order_by(col("o"), col("v"))
+
+    def build():
+        return (session.table("wdata")
+                .with_column("rn", F.row_number().over(w))
+                .with_column("sv", F.sum(col("v")).over(w)))
+
+    session.conf.set(MESH_KEY, 0)
+    want = build().to_pandas().sort_values(["g", "o", "v", "rn"]) \
+        .reset_index(drop=True)
+    session.conf.set(MESH_KEY, 8)
+    try:
+        got = build().to_pandas().sort_values(["g", "o", "v", "rn"]) \
+            .reset_index(drop=True)
+    finally:
+        session.conf.set(MESH_KEY, 0)
+    for c in want.columns:
+        assert got[c].tolist() == want[c].tolist(), c
+
+
+def test_sql_window_functions(wdata):
+    session, pdf = wdata
+    got = session.sql("""
+        SELECT g, o, v,
+               row_number() OVER (PARTITION BY g ORDER BY o, v) AS rn,
+               sum(v) OVER (PARTITION BY g) AS sv,
+               lag(v, 1) OVER (PARTITION BY g ORDER BY o, v) AS lg
+        FROM wdata
+    """).to_pandas()
+    s = pdf.sort_values(["o", "v"]).groupby("g")
+    want_rn = (s.cumcount() + 1).sort_index()
+    assert got["rn"].tolist() == want_rn.tolist()
+    assert got["sv"].tolist() == \
+        pdf.groupby("g")["v"].transform("sum").tolist()
+    s2 = pdf.sort_values(["g", "o", "v"], kind="stable")
+    want_lg = s2.groupby("g")["v"].shift(1).sort_index()
+    assert np.array_equal(got["lg"].fillna(-9).to_numpy(),
+                          want_lg.fillna(-9).to_numpy())
+
+
+def test_sql_rank_requires_over(wdata):
+    session, _ = wdata
+    from spark_tpu.sql.lexer import ParseError
+    with pytest.raises(ParseError, match="OVER"):
+        session.sql("SELECT rank() FROM wdata")
