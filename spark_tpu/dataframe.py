@@ -202,6 +202,23 @@ class DataFrame:
     def collect(self) -> pa.Table:
         return self._qe().collect()
 
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+    def cache(self) -> "DataFrame":
+        """Mark this plan for materialization on first action; later
+        queries containing an equal subtree read the cached batch
+        (reference: CacheManager.scala plan-fingerprint cache)."""
+        self.session.mark_cache(self.plan)
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        self.session.uncache(self.plan)
+        return self
+
     def to_pandas(self):
         return self.collect().to_pandas()
 
@@ -215,6 +232,58 @@ class DataFrame:
 
     def show(self, n: int = 20) -> None:
         print(self.limit(n).to_pandas().to_string())
+
+
+class DataFrameWriter:
+    """df.write.mode(...).parquet(path) (reference: DataFrameWriter +
+    FileFormatWriter.scala). Writes a directory of part files, so the
+    output reads back through the same directory-dataset scan path."""
+
+    _MODES = ("error", "errorifexists", "overwrite", "append", "ignore")
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._mode = "error"
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        m = m.lower()
+        if m not in self._MODES:
+            raise ValueError(f"unknown write mode {m!r}; one of "
+                             f"{self._MODES}")
+        self._mode = m
+        return self
+
+    def parquet(self, path: str) -> None:
+        import glob
+        import os
+        import shutil
+
+        import pyarrow.parquet as pq
+
+        exists = os.path.exists(path) and (
+            not os.path.isdir(path) or bool(os.listdir(path)))
+        if exists:
+            if self._mode in ("error", "errorifexists"):
+                raise FileExistsError(
+                    f"path {path!r} already exists (write mode=error)")
+            if self._mode == "ignore":
+                return
+        # execute BEFORE touching the target: a failing query must not
+        # destroy the previous output under mode=overwrite
+        table = self._df.collect()
+        if exists and self._mode == "overwrite":
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+        if os.path.exists(path) and not os.path.isdir(path):
+            raise NotADirectoryError(
+                f"append target {path!r} is a file, not a dataset "
+                f"directory")
+        os.makedirs(path, exist_ok=True)
+        n = len(glob.glob(os.path.join(path, "part-*.parquet")))
+        pq.write_table(table,
+                       os.path.join(path, f"part-{n:05d}.parquet"))
 
 
 class GroupedData:

@@ -54,11 +54,42 @@ class QueryExecution:
             self.phase_times["analysis"] = time.perf_counter() - t0
         return self._analyzed
 
+    def _apply_cache(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        """Substitute cached subtrees with scans over their materialized
+        tables (reference: CacheManager.useCachedData). A MARKED but
+        not-yet-materialized subtree appearing in any query materializes
+        on first use, like the reference's InMemoryRelation. Matching is
+        on the pre-optimization plan fingerprint."""
+        session = self.session
+        if not session._data_cache and not session._cache_requests:
+            return plan
+        root_fp = session._plan_fingerprint(plan)
+
+        def f(node):
+            fp = session._plan_fingerprint(node)
+            table = session._data_cache.get(fp)
+            if table is None and fp in session._cache_requests \
+                    and fp != root_fp:
+                # first use inside a larger query: materialize now (the
+                # fp != root_fp guard leaves root execution to the
+                # normal path, which fills the cache afterwards)
+                sub = QueryExecution(session, session._cache_requests[fp])
+                table = sub.collect()
+                session._data_cache[fp] = table
+            if table is not None:
+                from ..io.sources import ArrowTableSource
+                return L.Scan(ArrowTableSource("__cached__", table))
+            return None
+
+        # top-down so the largest cached subtree wins
+        return plan.transform_down(f)
+
     @property
     def optimized_plan(self) -> L.LogicalPlan:
         if self._optimized is None:
             t0 = time.perf_counter()
-            self._optimized = default_optimizer().execute(self.analyzed)
+            self._optimized = default_optimizer().execute(
+                self._apply_cache(self.analyzed))
             self.phase_times["optimization"] = time.perf_counter() - t0
         return self._optimized
 
@@ -103,16 +134,31 @@ class QueryExecution:
         for c in node.children:
             self._collect_scans(c, out)
 
-    def _materialize_streaming(self, node: P.PhysicalPlan) -> P.PhysicalPlan:
+    def _materialize_streaming(self, node: P.PhysicalPlan,
+                               mesh=None) -> P.PhysicalPlan:
         """Execute streamable aggregates eagerly (chunked, accumulator
-        carry) and splice their results back as InputExec leaves."""
-        from .streaming_agg import try_stream_aggregate
-        if isinstance(node, P.HashAggregateExec):
+        carry) and splice their results back as InputExec leaves. Under a
+        mesh, PARTIAL aggregates over chunked scans stream with per-shard
+        tables (the exchange + final stages above run unchanged)."""
+        from .streaming_agg import (stream_scan_aggregate_mesh,
+                                    try_stream_aggregate)
+        if mesh is None and isinstance(node, P.HashAggregateExec):
             result = try_stream_aggregate(node, self.session.conf,
                                           self.session._stage_cache)
             if result is not None:
                 return P.InputExec(result, node.schema(), label="streamed_agg")
-        new_children = tuple(self._materialize_streaming(c)
+        if mesh is not None and isinstance(node, P.HashAggregateExec) \
+                and node.mode == "partial":
+            result = stream_scan_aggregate_mesh(
+                node, mesh, self.session.conf, self.session._stage_cache)
+            if result is not None:
+                spliced = P.InputExec(result, node.schema(),
+                                      label="streamed_partial_agg")
+                # the final aggregate above resolves its functions
+                # against the PRE-aggregation schema
+                spliced._agg_base_schema = node._base_schema()
+                return spliced
+        new_children = tuple(self._materialize_streaming(c, mesh)
                              for c in node.children)
         if new_children != node.children:
             import copy
@@ -235,12 +281,7 @@ class QueryExecution:
         from ..parallel.mesh import get_mesh
         self._activate_conf()
         mesh = get_mesh(self.session.conf)
-        if mesh is None:
-            root = self._materialize_streaming(self.executed_plan)
-        else:
-            # the SPMD program IS the streaming discipline across shards;
-            # per-chunk host streaming composes with it in a later round
-            root = self.executed_plan
+        root = self._materialize_streaming(self.executed_plan, mesh)
         scans: List[P.LeafExec] = []
         self._collect_scans(root, scans)
 
@@ -304,6 +345,11 @@ class QueryExecution:
         self.phase_times["execution"] = time.perf_counter() - t0
         self.last_metrics = {k: int(np.asarray(v))
                              for k, v in metrics.items()}
+        # fill the data cache on the first action over a marked plan
+        fp = self.session._plan_fingerprint(self.logical)
+        if fp in self.session._cache_requests and \
+                fp not in self.session._data_cache:
+            self.session._data_cache[fp] = batch.to_arrow()
         return batch, flags, metrics
 
     def collect(self) -> pa.Table:

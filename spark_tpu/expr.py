@@ -647,6 +647,25 @@ class Pmod(Mod):
         return f"pmod({self.children[0]!r}, {self.children[1]!r})"
 
 
+def static_unsigned_bits(e: "Expression") -> Optional[int]:
+    """Static bound w with values of e in [0, 2^w), or None. Lets SUM
+    accumulators carry only the limbs the value range needs in the MXU
+    group-by kernel (pallas_groupby._limb_layout)."""
+    while isinstance(e, Alias):
+        e = e.children[0]
+    if isinstance(e, Pmod):
+        d = e.children[1]
+        while isinstance(d, (Alias, Cast)):
+            d = d.children[0]
+        if isinstance(d, Literal) and isinstance(d.value, int) \
+                and d.value > 0:
+            return max(1, (d.value - 1).bit_length())
+    if isinstance(e, Literal) and isinstance(e.value, int) \
+            and not isinstance(e.value, bool) and e.value >= 0:
+        return max(1, int(e.value).bit_length())
+    return None
+
+
 class Neg(Expression):
     def __init__(self, child):
         self.children = (child,)

@@ -167,7 +167,11 @@ class Sum(AggregateFunction):
         # our scaled-decimal representation anyway — no multi-limb
         # accumulator needed (the MXU kernel limb-decomposes internally)
         if isinstance(dt, (T.DecimalType, T.IntegralType)):
-            return [AccSpec("sum", np.dtype(np.int64), "sum"),
+            from .expr import static_unsigned_bits
+            w = static_unsigned_bits(self.child) if \
+                isinstance(dt, T.IntegralType) else None
+            return [AccSpec("sum", np.dtype(np.int64), "sum",
+                            width=min(w, 64) if w else 64),
                     AccSpec("cnt", np.dtype(np.int64), "sum", width=8)]
         return [AccSpec("sum", np.dtype(np.float64), "sum"),
                 AccSpec("cnt", np.dtype(np.int64), "sum", width=8)]

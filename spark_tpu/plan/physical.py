@@ -421,11 +421,17 @@ class HashAggregateExec(UnaryExec):
 
     def _base_schema(self) -> T.Schema:
         """Schema the aggregate functions' children resolve against: the
-        pre-aggregation input schema (threaded through partial->final)."""
+        pre-aggregation input schema (threaded through partial->final —
+        or stashed on a spliced InputExec when a streamed partial
+        replaced the subtree)."""
         node: PhysicalPlan = self
         while isinstance(node, (HashAggregateExec, ExchangeExec)):
+            stashed = getattr(node, "_agg_base_schema", None)
+            if stashed is not None:
+                return stashed
             node = node.children[0]
-        return node.schema()
+        stashed = getattr(node, "_agg_base_schema", None)
+        return stashed if stashed is not None else node.schema()
 
     def compute(self, ctx, inputs):
         batch = inputs[0]
@@ -566,6 +572,27 @@ class HashAggregateExec(UnaryExec):
             data, validity = a.func.device_finalize(accs[i], base)
             cols[a.out_name] = Column(data, a.func.result_type(base), validity)
         return Batch(cols, occupied)
+
+    def direct_partial_batch(self, tables, prep: "DirectAggPlan",
+                             dict_overrides: Optional[Dict] = None) -> Batch:
+        """Partial-mode output batch from carried accumulator tables:
+        group keys + RAW accumulator columns + occupancy selection (the
+        shape the exchange+final stages consume)."""
+        cnt, accs = tables
+        base = self._base_schema()
+        key_arrays = agg_kernels.direct_keys(prep.domains, prep.strides,
+                                             prep.key_dtypes)
+        cols: Dict[str, Column] = {}
+        for g, arr, dt, dic in zip(self.group_exprs, key_arrays,
+                                   prep.key_dtypes, prep.key_dicts):
+            if dict_overrides and g.name() in dict_overrides:
+                dic = dict_overrides[g.name()]
+            cols[g.name()] = Column(arr, dt, None, dic)
+        for i, a in enumerate(self.agg_exprs):
+            for j, spec in enumerate(prep.specs[i]):
+                cols[self._acc_col_name(i, j, spec)] = Column(
+                    accs[i][j], _np_to_logical(spec.np_dtype))
+        return Batch(cols, cnt > 0)
 
     def output_partitioning(self):
         if self.mode == "partial":

@@ -25,7 +25,26 @@ class SparkTpuSession:
         self.conf = conf or Conf()
         self.catalog: Dict[str, TableSource] = {}
         self._stage_cache: Dict[str, object] = {}
+        # plan-fingerprint data cache (reference: CacheManager.scala):
+        # requested marks fill with materialized Arrow tables on first
+        # action; later plans substitute equal subtrees with cached scans
+        self._cache_requests: Dict[str, object] = {}  # fp -> LogicalPlan
+        self._data_cache: Dict[str, pa.Table] = {}
         SparkTpuSession._active = self
+
+    # -- data cache ---------------------------------------------------------
+
+    @staticmethod
+    def _plan_fingerprint(plan) -> str:
+        return plan.tree_string()
+
+    def mark_cache(self, plan) -> None:
+        self._cache_requests[self._plan_fingerprint(plan)] = plan
+
+    def uncache(self, plan) -> None:
+        fp = self._plan_fingerprint(plan)
+        self._cache_requests.pop(fp, None)
+        self._data_cache.pop(fp, None)
 
     # -- builder ------------------------------------------------------------
 

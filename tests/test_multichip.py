@@ -377,3 +377,37 @@ def test_distributed_sort_skewed_keys(session):
     finally:
         session.conf.set(MESH_KEY, 0)
     assert got["k"].tolist() == sorted(pdf["k"].tolist())
+
+
+def test_distributed_streaming_aggregate(session):
+    """Chunked scan streaming under the mesh: per-shard accumulator
+    tables carried across host-ingested chunks (round-2 weak #7 — mesh
+    runs used to materialize whole scans)."""
+    import spark_tpu.execution.streaming_agg as SA
+
+    rs = np.random.RandomState(9)
+    pdf = pd.DataFrame({"v": rs.randint(0, 10**6, 5000).astype(np.int64)})
+    session.register_table("stream_t", pdf)
+    calls = []
+    orig = SA.stream_scan_aggregate_mesh
+
+    def spy(agg, mesh, conf, cache=None):
+        out = orig(agg, mesh, conf, cache)
+        calls.append(out is not None)
+        return out
+
+    SA.stream_scan_aggregate_mesh = spy
+    prev_chunk = session.conf.get("spark_tpu.sql.execution.streamingChunkRows")
+    session.conf.set("spark_tpu.sql.execution.streamingChunkRows", 1024)
+    try:
+        def build():
+            return (session.table("stream_t")
+                    .group_by((col("v") % 37).alias("k"))
+                    .agg(F.count().alias("c"), F.sum(col("v")).alias("s")))
+
+        _parity(session, build, ["k"])
+    finally:
+        SA.stream_scan_aggregate_mesh = orig
+        session.conf.set("spark_tpu.sql.execution.streamingChunkRows",
+                         prev_chunk)
+    assert any(calls), "mesh streaming path never engaged"

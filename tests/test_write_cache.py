@@ -1,0 +1,86 @@
+"""Write path (df.write.parquet) round-trips and the plan-fingerprint
+data cache (reference: FileFormatWriter.scala, CacheManager.scala)."""
+
+import decimal
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col, lit, to_date
+
+
+@pytest.fixture()
+def typed_table(session):
+    tbl = pa.table({
+        "i": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "d": pa.array([decimal.Decimal("1.25"), decimal.Decimal("-2.50"),
+                       decimal.Decimal("3.75"), decimal.Decimal("0.00")],
+                      type=pa.decimal128(10, 2)),
+        "dt": pa.array([18000, 18001, None, 18003], type=pa.date32()),
+        "s": pa.array(["aa", "bb", None, "aa"]),
+        "f": pa.array([1.5, None, 3.5, 4.5], type=pa.float64()),
+    })
+    session.register_table("wt", tbl)
+    return session, tbl
+
+
+def test_write_read_round_trip(typed_table, tmp_path):
+    session, tbl = typed_table
+    path = str(tmp_path / "out")
+    session.table("wt").write.parquet(path)
+    got = session.read_parquet(path).to_pandas()
+    want = tbl.to_pandas()
+    assert got["i"].tolist() == want["i"].tolist()
+    assert [str(x) for x in got["d"]] == [str(x) for x in want["d"]]
+    assert got["s"].tolist() == want["s"].tolist()
+    assert np.array_equal(got["f"].fillna(-1), want["f"].fillna(-1))
+    assert got["dt"].astype(str).tolist() == want["dt"].astype(str).tolist()
+
+
+def test_write_modes(typed_table, tmp_path):
+    session, tbl = typed_table
+    path = str(tmp_path / "modes")
+    df = session.table("wt")
+    df.write.parquet(path)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(path)
+    df.write.mode("ignore").parquet(path)
+    assert len(session.read_parquet(path).to_pandas()) == 4
+    df.write.mode("append").parquet(path)
+    assert len(session.read_parquet(path).to_pandas()) == 8
+    df.write.mode("overwrite").parquet(path)
+    assert len(session.read_parquet(path).to_pandas()) == 4
+
+
+def test_write_computed_frame(session, tmp_path):
+    path = str(tmp_path / "computed")
+    (session.range(100)
+     .select((col("id") * 2).alias("x"))
+     .filter(col("x") >= 100)
+     .write.parquet(path))
+    got = session.read_parquet(path).to_pandas()
+    assert got["x"].tolist() == list(range(100, 200, 2))
+
+
+def test_cache_hit_replaces_subtree(session):
+    pdf = pd.DataFrame({"k": np.arange(20, dtype=np.int64) % 4,
+                        "v": np.arange(20, dtype=np.int64)})
+    session.register_table("ct", pdf)
+    df = (session.table("ct").group_by(col("k"))
+          .agg(F.sum(col("v")).alias("s")))
+    df.cache()
+    first = df.to_pandas().sort_values("k").reset_index(drop=True)
+    # second run must plan against the cached scan, not the aggregate
+    qe2 = df._qe()
+    plan = qe2.optimized_plan.tree_string()
+    assert "__cached__" in plan, plan
+    second = df.to_pandas().sort_values("k").reset_index(drop=True)
+    assert first.equals(second)
+    # a LARGER query containing the cached subtree also uses it
+    top = df.filter(col("s") > 10)
+    assert "__cached__" in top._qe().optimized_plan.tree_string()
+    df.unpersist()
+    assert "__cached__" not in df._qe().optimized_plan.tree_string()
