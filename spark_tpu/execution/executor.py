@@ -84,12 +84,74 @@ class QueryExecution:
         # top-down so the largest cached subtree wins
         return plan.transform_down(f)
 
+    def _resolve_scalar_subqueries(self, plan: L.LogicalPlan
+                                   ) -> L.LogicalPlan:
+        """Execute uncorrelated scalar subqueries and substitute their
+        single value as a Literal — BEFORE optimization so the literal
+        participates in pushdown (reference: PlanSubqueries +
+        ScalarSubquery execution)."""
+        from ..expr import Literal
+
+        def expr_has(e) -> bool:
+            if isinstance(e, L.ScalarSubqueryExpr):
+                return True
+            return any(expr_has(c) for c in e.children)
+
+        def plan_exprs(n):
+            if isinstance(n, L.Project):
+                return n.exprs
+            if isinstance(n, L.Filter):
+                return (n.condition,)
+            if isinstance(n, L.Join):
+                es = list(n.left_keys) + list(n.right_keys)
+                if n.condition is not None:
+                    es.append(n.condition)
+                return es
+            if isinstance(n, L.Aggregate):
+                return (list(n.group_exprs)
+                        + [a.func.child for a in n.agg_exprs
+                           if a.func.child is not None])
+            if isinstance(n, L.Sort):
+                return [o.child for o in n.orders]
+            return ()
+
+        stack = [plan]
+        found = False
+        while stack and not found:
+            n = stack.pop()
+            stack.extend(n.children)
+            found = any(expr_has(e) for e in plan_exprs(n))
+        if not found:
+            return plan  # skip the rebuild on the no-subquery hot path
+
+        def fix(e):
+            def f(node):
+                if isinstance(node, L.ScalarSubqueryExpr):
+                    if len(node.plan.schema().fields) != 1:
+                        raise RuntimeError(
+                            "scalar subquery must return exactly one "
+                            "column")
+                    table = QueryExecution(self.session,
+                                           node.plan).collect()
+                    if table.num_rows > 1:
+                        raise RuntimeError(
+                            "scalar subquery returned more than one row")
+                    dt = node.plan.schema().fields[0].dtype
+                    val = None if table.num_rows == 0 else \
+                        table.column(0)[0].as_py()
+                    return Literal(val, dt)
+                return node
+            return e.transform_up(f)
+
+        return L.map_expressions(plan, fix)
+
     @property
     def optimized_plan(self) -> L.LogicalPlan:
         if self._optimized is None:
             t0 = time.perf_counter()
-            self._optimized = default_optimizer().execute(
-                self._apply_cache(self.analyzed))
+            plan = self._apply_cache(self.analyzed)
+            plan = self._resolve_scalar_subqueries(plan)
+            self._optimized = default_optimizer().execute(plan)
             self.phase_times["optimization"] = time.perf_counter() - t0
         return self._optimized
 

@@ -270,7 +270,17 @@ class Literal(Expression):
                        validity=jnp.zeros((), dtype=jnp.bool_))
         v = self.value
         if isinstance(self._dtype, T.DecimalType):
-            v = int(round(float(v) * 10 ** self._dtype.scale))
+            import decimal
+            if isinstance(v, decimal.Decimal):
+                # exact scaling (float round-trip loses last digits)
+                v = int((v * (10 ** self._dtype.scale)).to_integral_value(
+                    rounding=decimal.ROUND_HALF_UP))
+            else:
+                v = int(round(float(v) * 10 ** self._dtype.scale))
+        if isinstance(self._dtype, T.DateType):
+            import datetime
+            if isinstance(v, datetime.date):
+                v = (v - datetime.date(1970, 1, 1)).days
         if isinstance(self._dtype, T.StringType):
             # scalar strings stay host-side; comparisons special-case them
             return Vec(None, self._dtype, None, None)

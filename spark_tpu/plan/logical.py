@@ -88,6 +88,72 @@ class LeafPlan(LogicalPlan):
     pass
 
 
+class ScalarSubqueryExpr(Expression):
+    """An uncorrelated scalar subquery embedded in an expression
+    (reference: ScalarSubquery in subquery.scala). The executor runs the
+    subplan before tracing the outer query and substitutes its single
+    value as a Literal — the host-driven analog of Spark's subquery
+    stage execution."""
+
+    def __init__(self, plan: LogicalPlan):
+        self.plan = plan
+        self.children = ()
+
+    def dtype(self, schema):
+        return self.plan.schema().fields[0].dtype
+
+    def nullable(self, schema):
+        return True  # empty result -> NULL
+
+    def references(self):
+        return set()
+
+    def foldable(self):
+        return False
+
+    def __repr__(self):
+        return "scalar-subquery(...)"
+
+
+def map_expressions(plan: LogicalPlan, f) -> LogicalPlan:
+    """Rebuild a plan with every embedded expression passed through
+    `f: Expression -> Expression` (used for scalar-subquery substitution;
+    the reference's QueryPlan.transformExpressions)."""
+    import copy as _copy
+
+    def walk(node: LogicalPlan) -> LogicalPlan:
+        node = node.map_children(walk)
+        if isinstance(node, Project):
+            return Project(node.child, [f(e) for e in node.exprs])
+        if isinstance(node, Filter):
+            return Filter(node.child, f(node.condition))
+        if isinstance(node, Join):
+            return Join(node.left, node.right,
+                        [f(k) for k in node.left_keys],
+                        [f(k) for k in node.right_keys], node.how,
+                        None if node.condition is None
+                        else f(node.condition))
+        if isinstance(node, Aggregate):
+            aggs = []
+            for a in node.agg_exprs:
+                func = a.func
+                if func.child is not None:
+                    nf = _copy.copy(func)
+                    nf.child = f(func.child)
+                    nf.children = (nf.child,)
+                    func = nf
+                aggs.append(type(a)(func, a.out_name))
+            return Aggregate(node.child, [f(g) for g in node.group_exprs],
+                             aggs)
+        if isinstance(node, Sort):
+            return Sort(node.child, [SortOrder(f(o.child), o.ascending,
+                                               o.nulls_first)
+                                     for o in node.orders])
+        return node
+
+    return walk(plan)
+
+
 class Range(LeafPlan):
     """spark.range analog (reference: org.apache.spark.sql.execution.basicPhysicalOperators RangeExec)."""
 

@@ -331,12 +331,15 @@ class Parser:
         elif self.eat_kw("IN"):
             self.expect_op("(")
             if self.at_kw("SELECT"):
-                raise ParseError("IN (subquery) is not supported yet")
-            values = [self._literal_value()]
-            while self.eat_op(","):
-                values.append(self._literal_value())
-            self.expect_op(")")
-            e = In(e, tuple(values))
+                sub = self.parse_select()
+                self.expect_op(")")
+                e = _InSubquery(e, sub)
+            else:
+                values = [self._literal_value()]
+                while self.eat_op(","):
+                    values.append(self._literal_value())
+                self.expect_op(")")
+                e = In(e, tuple(values))
         elif self.eat_kw("LIKE"):
             t = self.next()
             if t.kind != "string":
@@ -408,6 +411,10 @@ class Parser:
     def parse_primary(self) -> Expression:
         t = self.peek()
         if self.eat_op("("):
+            if self.at_kw("SELECT"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return _ScalarSubquery(sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e
@@ -436,6 +443,12 @@ class Parser:
         if u == "INTERVAL":
             self.next()
             return _IntervalExpr(self._parse_interval())
+        if u == "EXISTS":
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return _ExistsSubquery(sub)
         if u == "CASE":
             return self.parse_case()
         if u == "CAST":
@@ -657,6 +670,47 @@ class Parser:
         raise ParseError(f"unknown function {name!r}")
 
 
+class _SubqueryExpr(Expression):
+    """Base for parse-time subquery expressions; consumed by the
+    Lowerer's rewrite passes (reference: `optimizer/subquery.scala`
+    RewritePredicateSubquery / RewriteCorrelatedScalarSubquery)."""
+
+    def __init__(self, select: "_Select", child: Optional[Expression] = None):
+        self.select = select
+        self.children = () if child is None else (child,)
+
+    def references(self):
+        return set() if not self.children else self.children[0].references()
+
+    def dtype(self, schema):
+        raise AnalysisError(
+            f"{type(self).__name__} must be rewritten before analysis")
+
+
+class _InSubquery(_SubqueryExpr):
+    def __init__(self, child: Expression, select: "_Select"):
+        super().__init__(select, child)
+
+    def __repr__(self):
+        return f"({self.children[0]!r} IN (<subquery>))"
+
+
+class _ExistsSubquery(_SubqueryExpr):
+    def __repr__(self):
+        return "EXISTS(<subquery>)"
+
+
+class _ScalarSubquery(_SubqueryExpr):
+    def __repr__(self):
+        return "(<scalar subquery>)"
+
+
+def _contains_subquery(e: Expression) -> bool:
+    if isinstance(e, _SubqueryExpr):
+        return True
+    return any(_contains_subquery(c) for c in e.children)
+
+
 class _RankingCall(Expression):
     """Parse-time sentinel for row_number/rank/dense_rank/lag/lead —
     only valid immediately followed by OVER."""
@@ -876,6 +930,7 @@ class Lowerer:
     def __init__(self, session):
         self.session = session
         self._agg_counter = 0
+        self._sq_counter = 0
 
     def lower(self, sel: _Select) -> L.LogicalPlan:
         if sel.union_of is not None:
@@ -886,9 +941,13 @@ class Lowerer:
                 plan = L.Limit(plan, sel.limit)
             return plan
         plan, remaining, scope = self._lower_from(sel)
-        if remaining:
+        plain = [c for c in remaining if not _contains_subquery(c)]
+        subq = [c for c in remaining if _contains_subquery(c)]
+        if plain:
             plan = L.Filter(plan, _and_all([scope.rewrite(c)
-                                            for c in remaining]))
+                                            for c in plain]))
+        for c in subq:
+            plan = self._rewrite_subquery_conjunct(plan, c, scope)
         sel = _Select(
             items=[(scope.rewrite(e), a) for e, a in (sel.items or [])],
             star=sel.star, distinct=sel.distinct,
@@ -957,7 +1016,10 @@ class Lowerer:
         # and cross-join intermediates small)
         def push_single(alias, plan):
             nonlocal where
-            mine = [c for c in where if refs(c) == {alias}]
+            # subquery conjuncts must survive to the rewrite pass — their
+            # inner references are invisible to references()
+            mine = [c for c in where
+                    if not _contains_subquery(c) and refs(c) == {alias}]
             if mine:
                 # identity-based removal: Expression.__eq__ is the DSL EQ
                 # constructor, so `c in mine` would match everything
@@ -1005,7 +1067,8 @@ class Lowerer:
             progressed = False
             for i, (a, p) in enumerate(pending):
                 linking = [c for c in where
-                           if refs(c) and refs(c) <= (bound | {a})
+                           if not _contains_subquery(c)
+                           and refs(c) and refs(c) <= (bound | {a})
                            and a in refs(c)
                            and (refs(c) & bound)]
                 lk, rk, residual = _split_equi(linking, scope, bound, {a})
@@ -1232,6 +1295,192 @@ class Lowerer:
             plan = L.Filter(plan, having_expr)
         plan = L.Project(plan, post)
         return self._lower_order_limit(sel, plan)
+
+    # -- subquery rewrites (reference: optimizer/subquery.scala) ------------
+
+    def _split_correlation(self, sub: _Select, outer_scope: _Scope):
+        """For a single-relation subquery, split its WHERE into local
+        conjuncts (rewritten to inner flat names) and
+        (outer_expr, inner_expr) equi-correlation pairs.
+        Returns (rel_ref, alias, local_conjuncts, pairs)."""
+        if not sub.relations or len(sub.relations) != 1 or sub.joins:
+            raise AnalysisError(
+                "correlated subqueries support a single FROM relation")
+        if sub.group_by or sub.having or sub.limit is not None \
+                or sub.order_by:
+            raise AnalysisError(
+                "GROUP BY/HAVING/ORDER BY/LIMIT inside a correlated "
+                "predicate subquery is not supported")
+        ref, alias = sub.relations[0]
+        inner_alias = alias or (ref if isinstance(ref, str) else "__sub")
+        inner_plan = self._rel_plan(ref)
+        inner_scope = _Scope()
+        inner_scope.add(inner_alias, inner_plan.schema().names)
+        inner_names = set(inner_plan.schema().names)
+
+        def side(e: Expression) -> str:
+            """'inner' | 'outer' | 'mixed' | 'none', honoring qualifiers
+            (references() drops them, which misclassified
+            `bounds.k = tiny.k`-style correlation)."""
+            saw_inner = saw_outer = False
+
+            def walk(node):
+                nonlocal saw_inner, saw_outer
+                if isinstance(node, _QualifiedRef):
+                    if node.qualifier == inner_alias and \
+                            node.col in inner_names:
+                        saw_inner = True
+                    else:
+                        saw_outer = True
+                    return
+                if isinstance(node, ColumnRef):
+                    if node.name() in inner_names:
+                        saw_inner = True
+                    else:
+                        saw_outer = True
+                    return
+                for c in node.children:
+                    walk(c)
+
+            walk(e)
+            if saw_inner and saw_outer:
+                return "mixed"
+            if saw_inner:
+                return "inner"
+            if saw_outer:
+                return "outer"
+            return "none"
+
+        local, pairs = [], []
+        for c in _conjuncts(sub.where):
+            s = side(c)
+            if s in ("inner", "none"):
+                local.append(inner_scope.rewrite(c))
+                continue
+            if isinstance(c, EQ):
+                a, b = c.children
+                for inner_e, outer_e in ((a, b), (b, a)):
+                    if side(inner_e) == "inner" and \
+                            side(outer_e) == "outer":
+                        pairs.append((outer_scope.rewrite(outer_e),
+                                      inner_scope.rewrite(inner_e)))
+                        break
+                else:
+                    raise AnalysisError(
+                        f"unsupported correlated conjunct: {c!r}")
+            else:
+                raise AnalysisError(
+                    f"correlated subqueries support equi-correlation "
+                    f"only (got {c!r})")
+        return ref, alias, local, pairs
+
+    def _rewrite_subquery_conjunct(self, plan: L.LogicalPlan,
+                                   c: Expression, scope: _Scope
+                                   ) -> L.LogicalPlan:
+        """Turn one WHERE conjunct containing a subquery into joins
+        (IN -> left_semi, NOT IN -> left_anti, EXISTS likewise; scalar
+        subqueries substitute an executed literal when uncorrelated, or
+        a grouped-aggregate join when equi-correlated)."""
+        negate = False
+        e = c
+        while isinstance(e, Not) and isinstance(e.children[0],
+                                                (_InSubquery,
+                                                 _ExistsSubquery)):
+            negate = not negate
+            e = e.children[0]
+
+        if isinstance(e, _InSubquery):
+            sub_plan = self.lower(e.select)
+            out_cols = sub_plan.schema().names
+            if len(out_cols) != 1:
+                raise AnalysisError(
+                    "IN (subquery) requires exactly one output column")
+            how = "left_anti" if negate else "left_semi"
+            # NOTE: NOT IN over a subquery producing NULLs deviates from
+            # SQL's null-aware anti-join (rows are kept, not dropped)
+            return L.Join(plan, sub_plan, [scope.rewrite(e.children[0])],
+                          [ColumnRef(out_cols[0])], how)
+
+        if isinstance(e, _ExistsSubquery):
+            ref, _alias, local, pairs = self._split_correlation(
+                e.select, scope)
+            if not pairs:
+                raise AnalysisError(
+                    "uncorrelated EXISTS is not supported (it is a "
+                    "constant — filter host-side instead)")
+            inner = self._rel_plan(ref)
+            if local:
+                inner = L.Filter(inner, _and_all(local))
+            how = "left_anti" if negate else "left_semi"
+            return L.Join(plan, inner, [p[0] for p in pairs],
+                          [p[1] for p in pairs], how)
+
+        # comparison (or expression) containing scalar subqueries
+        return self._rewrite_scalar_in_conjunct(plan, c, scope)
+
+    def _rewrite_scalar_in_conjunct(self, plan, c: Expression,
+                                    scope: _Scope) -> L.LogicalPlan:
+        def has_correlation(sub: _Select) -> bool:
+            if not (sub.relations and len(sub.relations) == 1
+                    and not sub.joins):
+                return False
+            ref, alias = sub.relations[0]
+            inner_alias = alias or (ref if isinstance(ref, str)
+                                    else "__sub")
+            inner_names = set(self._rel_plan(ref).schema().names)
+
+            def outer_ref(e) -> bool:
+                if isinstance(e, _QualifiedRef):
+                    return not (e.qualifier == inner_alias
+                                and e.col in inner_names)
+                if isinstance(e, ColumnRef):
+                    return e.name() not in inner_names
+                return any(outer_ref(k) for k in e.children)
+
+            return any(outer_ref(cc) for cc in _conjuncts(sub.where))
+
+        def rewrite(e: Expression) -> Expression:
+            nonlocal plan
+            if isinstance(e, _ScalarSubquery):
+                sub = e.select
+                if not has_correlation(sub):
+                    return L.ScalarSubqueryExpr(self.lower(sub))
+                # correlated scalar aggregate -> grouped aggregate joined
+                # on the correlation keys (RewriteCorrelatedScalarSubquery)
+                ref, alias, local, pairs = self._split_correlation(
+                    sub, scope)
+                if len(sub.items or []) != 1:
+                    raise AnalysisError(
+                        "correlated scalar subquery needs exactly one "
+                        "select item")
+                # session-unique generated names: two correlated
+                # subqueries in one query must not collide (the join
+                # would rename the second to __sq_valN_r while the
+                # filter still referenced __sq_valN)
+                self._sq_counter += 1
+                sq = self._sq_counter
+                key_items = [(ie, f"__sq{sq}_key{i}")
+                             for i, (_oe, ie) in enumerate(pairs)]
+                val_name = f"__sq{sq}_val"
+                inner_sel = _Select(
+                    items=[(ie, nm) for ie, nm in key_items]
+                    + [(sub.items[0][0], val_name)],
+                    relations=[(ref, alias)],
+                    where=_and_all(local),
+                    group_by=[ie for ie, _nm in key_items])
+                sub_plan = self.lower(inner_sel)
+                # LEFT join: outer rows without a matching group keep a
+                # NULL subquery value (SQL semantics; an inner join
+                # would wrongly drop them under OR-combined predicates)
+                plan = L.Join(plan, sub_plan,
+                              [oe for oe, _ie in pairs],
+                              [ColumnRef(nm) for _ie, nm in key_items],
+                              "left")
+                return ColumnRef(val_name)
+            return e.map_children(rewrite)
+
+        cond = scope.rewrite(rewrite(c))
+        return L.Filter(plan, cond)
 
     def _extract_window_items(self, plan: L.LogicalPlan, items):
         """Pull WindowExpr nodes into Window plan nodes below the

@@ -125,3 +125,83 @@ def compare(got: pd.DataFrame, want: pd.DataFrame,
         except (ValueError, TypeError):
             if list(g.astype(str)) != list(w.astype(str)):
                 raise AssertionError(f"column {c} diverges:\n{g}\n{w}")
+
+
+def q4(path: str) -> pd.DataFrame:
+    o = _read(path, "orders")
+    l = _read(path, "lineitem")
+    o = o[(o["o_orderdate"] >= pd.Timestamp("1993-07-01").date())
+          & (o["o_orderdate"] < pd.Timestamp("1993-10-01").date())]
+    late = l[l["l_commitdate"] < l["l_receiptdate"]]["l_orderkey"].unique()
+    m = o[o["o_orderkey"].isin(late)]
+    out = (m.groupby("o_orderpriority", as_index=False)
+           .agg(order_count=("o_orderkey", "size"))
+           .sort_values("o_orderpriority"))
+    return out.reset_index(drop=True)
+
+
+def q12(path: str) -> pd.DataFrame:
+    o = _read(path, "orders")
+    l = _read(path, "lineitem")
+    l = l[l["l_shipmode"].isin(["MAIL", "SHIP"])
+          & (l["l_commitdate"] < l["l_receiptdate"])
+          & (l["l_shipdate"] < l["l_commitdate"])
+          & (l["l_receiptdate"] >= pd.Timestamp("1994-01-01").date())
+          & (l["l_receiptdate"] < pd.Timestamp("1995-01-01").date())]
+    m = l.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    high = m["o_orderpriority"].isin(["1-URGENT", "2-HIGH"])
+    m = m.assign(high_line_count=high.astype(np.int64),
+                 low_line_count=(~high).astype(np.int64))
+    out = (m.groupby("l_shipmode", as_index=False)
+           .agg(high_line_count=("high_line_count", "sum"),
+                low_line_count=("low_line_count", "sum"))
+           .sort_values("l_shipmode"))
+    return out.reset_index(drop=True)
+
+
+def q14(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    p = _read(path, "part")
+    l = l[(l["l_shipdate"] >= pd.Timestamp("1995-09-01").date())
+          & (l["l_shipdate"] < pd.Timestamp("1995-10-01").date())]
+    m = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = m["l_extendedprice"] * (1 - m["l_discount"])
+    promo = rev.where(m["p_type"].str.startswith("TYPE 1"), 0.0)
+    return pd.DataFrame({"promo_revenue":
+                         [100.0 * promo.sum() / rev.sum()]})
+
+
+def q17(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    p = _read(path, "part")
+    p = p[(p["p_brand"] == "Brand#23") & (p["p_container"] == "CONTAINER 7")]
+    m = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    avg_qty = l.groupby("l_partkey")["l_quantity"].mean()
+    thresh = m["l_partkey"].map(avg_qty) * 0.2
+    m = m[m["l_quantity"] < thresh]
+    return pd.DataFrame({"avg_yearly":
+                         [m["l_extendedprice"].sum() / 7.0]})
+
+
+def q19(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    p = _read(path, "part")
+    m = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    m = m[m["l_shipmode"].isin(["AIR", "AIR REG"])
+          & (m["l_shipinstruct"] == "DELIVER IN PERSON")]
+    c1 = ((m["p_brand"] == "Brand#12")
+          & (m["l_quantity"] >= 1) & (m["l_quantity"] <= 11)
+          & (m["p_size"] >= 1) & (m["p_size"] <= 5))
+    c2 = ((m["p_brand"] == "Brand#23")
+          & (m["l_quantity"] >= 10) & (m["l_quantity"] <= 20)
+          & (m["p_size"] >= 1) & (m["p_size"] <= 10))
+    c3 = ((m["p_brand"] == "Brand#34")
+          & (m["l_quantity"] >= 20) & (m["l_quantity"] <= 30)
+          & (m["p_size"] >= 1) & (m["p_size"] <= 15))
+    m = m[c1 | c2 | c3]
+    # SQL SUM over zero rows is NULL, not 0 (small SFs select nothing)
+    rev = (m["l_extendedprice"] * (1 - m["l_discount"])).sum()
+    return pd.DataFrame({"revenue": [rev if len(m) else np.nan]})
+
+
+GOLDEN.update({"q4": q4, "q12": q12, "q14": q14, "q17": q17, "q19": q19})

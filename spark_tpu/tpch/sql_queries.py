@@ -93,3 +93,102 @@ where
 """
 
 SQL_QUERIES = {"q1": Q1, "q3": Q3, "q5": Q5, "q6": Q6}
+
+Q4 = """
+select
+    o_orderpriority,
+    count(*) as order_count
+from
+    orders
+where
+    o_orderdate >= date '1993-07-01'
+    and o_orderdate < date '1993-07-01' + interval '3' month
+    and exists (
+        select * from lineitem
+        where l_orderkey = o_orderkey
+          and l_commitdate < l_receiptdate
+    )
+group by
+    o_orderpriority
+order by
+    o_orderpriority
+"""
+
+Q12 = """
+select
+    l_shipmode,
+    sum(case when o_orderpriority = '1-URGENT'
+             or o_orderpriority = '2-HIGH' then 1 else 0 end)
+        as high_line_count,
+    sum(case when o_orderpriority <> '1-URGENT'
+             and o_orderpriority <> '2-HIGH' then 1 else 0 end)
+        as low_line_count
+from
+    orders,
+    lineitem
+where
+    o_orderkey = l_orderkey
+    and l_shipmode in ('MAIL', 'SHIP')
+    and l_commitdate < l_receiptdate
+    and l_shipdate < l_commitdate
+    and l_receiptdate >= date '1994-01-01'
+    and l_receiptdate < date '1994-01-01' + interval '1' year
+group by
+    l_shipmode
+order by
+    l_shipmode
+"""
+
+Q14 = """
+select
+    100.00 * sum(case when p_type like 'TYPE 1%'
+                      then l_extendedprice * (1 - l_discount)
+                      else 0 end)
+        / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+from
+    lineitem,
+    part
+where
+    l_partkey = p_partkey
+    and l_shipdate >= date '1995-09-01'
+    and l_shipdate < date '1995-09-01' + interval '1' month
+"""
+
+Q17 = """
+select
+    sum(l_extendedprice) / 7.0 as avg_yearly
+from
+    lineitem,
+    part
+where
+    p_partkey = l_partkey
+    and p_brand = 'Brand#23'
+    and p_container = 'CONTAINER 7'
+    and l_quantity < (
+        select 0.2 * avg(l_quantity) from lineitem
+        where l_partkey = p_partkey
+    )
+"""
+
+Q19 = """
+select
+    sum(l_extendedprice * (1 - l_discount)) as revenue
+from
+    lineitem,
+    part
+where
+    p_partkey = l_partkey
+    and l_shipmode in ('AIR', 'AIR REG')
+    and l_shipinstruct = 'DELIVER IN PERSON'
+    and (
+        (p_brand = 'Brand#12' and l_quantity between 1 and 11
+         and p_size between 1 and 5)
+        or (p_brand = 'Brand#23' and l_quantity between 10 and 20
+            and p_size between 1 and 10)
+        or (p_brand = 'Brand#34' and l_quantity between 20 and 30
+            and p_size between 1 and 15)
+    )
+"""
+
+SQL_QUERIES.update({"q4": Q4, "q12": Q12, "q14": Q14, "q17": Q17,
+                    "q19": Q19})

@@ -243,3 +243,92 @@ def test_decimal_float_compare_large_values(session):
     got = (session.table("bigdec").filter(col("d") > lit(5e17))
            .to_pandas())
     assert len(got) == 1
+
+
+@pytest.mark.parametrize("qname", ["q4", "q12", "q14", "q17", "q19"])
+def test_tpch_sql_extended(sql_session, qname):
+    got = _norm(sql_session.sql(SQL_QUERIES[qname]).to_pandas())
+    want = G.GOLDEN[qname](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)
+
+
+def test_uncorrelated_scalar_subquery(tiny):
+    got = tiny.sql("""
+        SELECT k, v FROM tiny WHERE v > (SELECT avg(v) FROM tiny)
+        ORDER BY v
+    """).to_pandas()
+    assert got["v"].tolist() == [40.0, 50.0, 60.0]
+
+
+def test_in_subquery(tiny):
+    got = tiny.sql("""
+        SELECT v FROM tiny WHERE k IN (SELECT k FROM other WHERE w < 300)
+        ORDER BY v
+    """).to_pandas()
+    assert got["v"].tolist() == [10.0, 20.0, 30.0, 50.0, 60.0]
+    got = tiny.sql("""
+        SELECT v FROM tiny WHERE k NOT IN (SELECT k FROM other)
+        ORDER BY v
+    """).to_pandas()
+    assert got["v"].tolist() == [40.0]
+
+
+@pytest.fixture(scope="session")
+def bounds(session):
+    session.register_table("bounds", pd.DataFrame({
+        "bk": [1, 2, 3], "lo": [15, 100, 35], "hi": [100, 10, 45]}))
+    session.register_table("t2", pd.DataFrame({
+        "k": [1, 2, 3, 4], "v": [10.0, 30.0, 50.0, 99.0]}))
+    return session
+
+
+def test_two_correlated_scalar_subqueries(bounds):
+    """Code-review: generated names collided across conjuncts."""
+    got = bounds.sql("""
+        SELECT v FROM t2
+        WHERE v < (SELECT min(hi) FROM bounds WHERE bk = k)
+          AND v > (SELECT max(lo) FROM bounds WHERE bk = k) - 10
+        ORDER BY v
+    """).to_pandas()
+    # k=1: 5 < v < 100 -> 10 in; k=2: v<10 & v>90 -> none; k=3: 25<v<45 -> none (50 out)
+    assert got["v"].tolist() == [10.0]
+
+
+def test_correlated_scalar_left_join_semantics(bounds):
+    """Code-review: inner join dropped rows with no matching group even
+    when an OR-disjunct made the predicate true."""
+    got = bounds.sql("""
+        SELECT v FROM t2
+        WHERE v = 99 OR v > (SELECT min(lo) FROM bounds WHERE bk = k)
+        ORDER BY v
+    """).to_pandas()
+    # k=3: 50 > 35 in; k=4 has no group but v=99 disjunct holds
+    assert got["v"].tolist() == [50.0, 99.0]
+
+
+def test_qualified_correlation(bounds):
+    got = bounds.sql("""
+        SELECT v FROM t2
+        WHERE v > (SELECT min(bounds.lo) FROM bounds
+                   WHERE bounds.bk = t2.k)
+        ORDER BY v
+    """).to_pandas()
+    assert got["v"].tolist() == [50.0]
+
+
+def test_exists_with_qualified_local_conjunct(bounds):
+    got = bounds.sql("""
+        SELECT v FROM t2 t
+        WHERE EXISTS (SELECT * FROM bounds b
+                      WHERE b.bk = t.k AND b.lo < 50)
+        ORDER BY v
+    """).to_pandas()
+    assert got["v"].tolist() == [10.0, 50.0]
+
+
+def test_scalar_subquery_multi_column_raises(bounds):
+    with pytest.raises(RuntimeError, match="one column"):
+        bounds.sql(
+            "SELECT v FROM t2 WHERE v > (SELECT lo, hi FROM bounds)"
+        ).to_pandas()
