@@ -158,6 +158,20 @@ PROFILE_DIR = register(
     doc="When set, wrap query execution in a jax.profiler trace written "
         "to this directory (one trace per execute).")
 
+CLUSTER_COORDINATOR = register(
+    "spark_tpu.sql.cluster.coordinator", "",
+    doc="host:port of the jax.distributed coordinator for multi-host "
+        "meshes (empty = single host). Every host runs the same engine "
+        "process; parallel.mesh.init_distributed dials in.")
+
+CLUSTER_NUM_PROCESSES = register(
+    "spark_tpu.sql.cluster.numProcesses", 1,
+    doc="Number of engine processes (hosts) in the multi-host cluster.")
+
+CLUSTER_PROCESS_ID = register(
+    "spark_tpu.sql.cluster.processId", 0,
+    doc="This process's rank within the multi-host cluster.")
+
 MESH_SIZE = register(
     "spark_tpu.sql.mesh.size", 0,
     doc="Number of devices on the data axis of the SPMD mesh. 0 or 1 "

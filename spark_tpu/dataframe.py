@@ -206,6 +206,10 @@ class DataFrame:
     def write(self) -> "DataFrameWriter":
         return DataFrameWriter(self)
 
+    @property
+    def stat(self) -> "DataFrameStat":
+        return DataFrameStat(self)
+
     def cache(self) -> "DataFrame":
         """Mark this plan for materialization on first action; later
         queries containing an equal subtree read the cached batch
@@ -284,6 +288,40 @@ class DataFrameWriter:
         n = len(glob.glob(os.path.join(path, "part-*.parquet")))
         pq.write_table(table,
                        os.path.join(path, f"part-{n:05d}.parquet"))
+
+
+class DataFrameStat:
+    """df.stat.* (reference: DataFrameStatFunctions — the sketch entry
+    points backed by common/sketch)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+
+    def _column_device(self, col_name: str):
+        from .execution.executor import QueryExecution
+        qe = QueryExecution(self._df.session,
+                            L.Project(self._df.plan, [ColumnRef(col_name)]))
+        batch, _, _ = qe.execute_batch()
+        c = batch.columns[batch.names[0]]
+        sel = batch.selection_mask()
+        mask = sel if c.validity is None else (sel & c.validity)
+        return c.data, mask
+
+    def bloom_filter(self, col_name: str, expected_items: int,
+                     fpp: float = 0.03):
+        from .sketch import BloomFilter
+        data, mask = self._column_device(col_name)
+        return BloomFilter.build(data, expected_items, fpp, mask=mask)
+
+    bloomFilter = bloom_filter
+
+    def count_min_sketch(self, col_name: str, eps: float = 0.001,
+                         confidence: float = 0.99):
+        from .sketch import CountMinSketch
+        data, mask = self._column_device(col_name)
+        return CountMinSketch.build(data, eps, confidence, mask=mask)
+
+    countMinSketch = count_min_sketch
 
 
 class GroupedData:

@@ -97,31 +97,7 @@ class QueryExecution:
                 return True
             return any(expr_has(c) for c in e.children)
 
-        def plan_exprs(n):
-            if isinstance(n, L.Project):
-                return n.exprs
-            if isinstance(n, L.Filter):
-                return (n.condition,)
-            if isinstance(n, L.Join):
-                es = list(n.left_keys) + list(n.right_keys)
-                if n.condition is not None:
-                    es.append(n.condition)
-                return es
-            if isinstance(n, L.Aggregate):
-                return (list(n.group_exprs)
-                        + [a.func.child for a in n.agg_exprs
-                           if a.func.child is not None])
-            if isinstance(n, L.Sort):
-                return [o.child for o in n.orders]
-            return ()
-
-        stack = [plan]
-        found = False
-        while stack and not found:
-            n = stack.pop()
-            stack.extend(n.children)
-            found = any(expr_has(e) for e in plan_exprs(n))
-        if not found:
+        if not any(expr_has(e) for e in L.iter_expressions(plan)):
             return plan  # skip the rebuild on the no-subquery hot path
 
         def fix(e):

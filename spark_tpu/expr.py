@@ -271,12 +271,12 @@ class Literal(Expression):
         v = self.value
         if isinstance(self._dtype, T.DecimalType):
             import decimal
-            if isinstance(v, decimal.Decimal):
-                # exact scaling (float round-trip loses last digits)
-                v = int((v * (10 ** self._dtype.scale)).to_integral_value(
-                    rounding=decimal.ROUND_HALF_UP))
-            else:
-                v = int(round(float(v) * 10 ** self._dtype.scale))
+            if not isinstance(v, decimal.Decimal):
+                # via str() so 0.05 means 5e-2, and with the same HALF_UP
+                # as the Decimal path (round() would banker's-round ties)
+                v = decimal.Decimal(str(v))
+            v = int((v * (10 ** self._dtype.scale)).to_integral_value(
+                rounding=decimal.ROUND_HALF_UP))
         if isinstance(self._dtype, T.DateType):
             import datetime
             if isinstance(v, datetime.date):

@@ -253,6 +253,35 @@ class ArrowTableSource(TableSource):
         return ChunkIterator(iter(t.to_batches()), chunk_rows)
 
 
+class CsvSource(ArrowTableSource):
+    """CSV via the C++ Arrow reader (reference: csv/CSVFileFormat +
+    UnivocityParser; here native decode + dictionary-encoding happen
+    before any bytes reach the device). Eagerly read: CSV has no
+    row-group skipping, so pushdown happens post-parse in Arrow."""
+
+    def __init__(self, path: str, name: Optional[str] = None, **options):
+        import pyarrow.csv as pa_csv
+        parse = pa_csv.ParseOptions(
+            delimiter=options.get("sep", options.get("delimiter", ",")))
+        read = pa_csv.ReadOptions(
+            autogenerate_column_names=not options.get("header", True))
+        table = pa_csv.read_csv(path, parse_options=parse,
+                                read_options=read)
+        super().__init__(name or os.path.basename(path).split(".")[0],
+                         table)
+
+
+class JsonSource(ArrowTableSource):
+    """Line-delimited JSON via the C++ Arrow reader (reference:
+    json/JsonFileFormat + JacksonParser)."""
+
+    def __init__(self, path: str, name: Optional[str] = None):
+        import pyarrow.json as pa_json
+        table = pa_json.read_json(path)
+        super().__init__(name or os.path.basename(path).split(".")[0],
+                         table)
+
+
 class ParquetSource(TableSource):
     """Parquet directory/file via the C++ Arrow dataset reader: column
     pruning + row-group predicate skipping happen in native code before

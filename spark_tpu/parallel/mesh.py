@@ -25,6 +25,7 @@ def get_mesh(conf) -> Optional[Mesh]:
     n = mesh_size(conf)
     if n <= 1:
         return None
+    init_distributed(conf)  # no-op unless cluster.coordinator is set
     devices = jax.devices()
     if len(devices) < n:
         raise RuntimeError(
@@ -33,3 +34,35 @@ def get_mesh(conf) -> Optional[Mesh]:
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
     import numpy as np
     return Mesh(np.array(devices[:n]), (AXIS,))
+
+
+def init_distributed(conf) -> int:
+    """Multi-host bring-up: initialize the JAX distributed runtime so
+    `jax.devices()` spans every host's chips and the engine's collectives
+    ride ICI within a slice and DCN across slices.
+
+    The control-plane analog of the reference's executor registration
+    (`CoarseGrainedExecutorBackend.main:405` dialing the driver): every
+    host runs the SAME engine process, pointed at one coordinator:
+
+        spark_tpu.sql.cluster.coordinator = host0:8476
+        spark_tpu.sql.cluster.numProcesses = <hosts>
+        spark_tpu.sql.cluster.processId   = <this host's rank>
+
+    After init, set spark_tpu.sql.mesh.size to the GLOBAL device count;
+    gang SPMD replaces the reference's scheduler/shuffle-service fleet —
+    there is no other inter-host protocol to deploy. Returns the global
+    device count. No-op (returns local count) when no coordinator is
+    configured; idempotent per process."""
+    coord = str(conf.get("spark_tpu.sql.cluster.coordinator") or "")
+    if not coord:
+        return len(jax.devices())
+    num = int(conf.get("spark_tpu.sql.cluster.numProcesses"))
+    pid = int(conf.get("spark_tpu.sql.cluster.processId"))
+    state = getattr(jax.distributed, "global_state", None)
+    already = state is not None and \
+        getattr(state, "coordinator_address", None)
+    if not already:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=num, process_id=pid)
+    return len(jax.devices())

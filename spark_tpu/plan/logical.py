@@ -115,10 +115,41 @@ class ScalarSubqueryExpr(Expression):
         return "scalar-subquery(...)"
 
 
+def iter_expressions(plan: LogicalPlan):
+    """Yield every expression embedded anywhere in the plan — the single
+    enumeration of expression-bearing slots (keep map_expressions' node
+    cases in sync with this)."""
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        stack.extend(n.children)
+        if isinstance(n, Project):
+            yield from n.exprs
+        elif isinstance(n, Filter):
+            yield n.condition
+        elif isinstance(n, Join):
+            yield from n.left_keys
+            yield from n.right_keys
+            if n.condition is not None:
+                yield n.condition
+        elif isinstance(n, Aggregate):
+            yield from n.group_exprs
+            for a in n.agg_exprs:
+                if a.func.child is not None:
+                    yield a.func.child
+        elif isinstance(n, Sort):
+            for o in n.orders:
+                yield o.child
+        elif isinstance(n, WindowPlan):
+            for w, _name in n.wexprs:
+                yield from w.children
+
+
 def map_expressions(plan: LogicalPlan, f) -> LogicalPlan:
     """Rebuild a plan with every embedded expression passed through
     `f: Expression -> Expression` (used for scalar-subquery substitution;
-    the reference's QueryPlan.transformExpressions)."""
+    the reference's QueryPlan.transformExpressions). Node cases must
+    mirror iter_expressions."""
     import copy as _copy
 
     def walk(node: LogicalPlan) -> LogicalPlan:
@@ -149,6 +180,10 @@ def map_expressions(plan: LogicalPlan, f) -> LogicalPlan:
             return Sort(node.child, [SortOrder(f(o.child), o.ascending,
                                                o.nulls_first)
                                      for o in node.orders])
+        if isinstance(node, WindowPlan):
+            return WindowPlan(node.child,
+                              [(w.map_children(f), name)
+                               for w, name in node.wexprs])
         return node
 
     return walk(plan)

@@ -113,6 +113,15 @@ class SparkTpuSession:
     def read_parquet(self, path: str, name: Optional[str] = None) -> DataFrame:
         return DataFrame(self, L.Scan(ParquetSource(path, name)))
 
+    def read_csv(self, path: str, name: Optional[str] = None,
+                 **options) -> DataFrame:
+        from .io.sources import CsvSource
+        return DataFrame(self, L.Scan(CsvSource(path, name, **options)))
+
+    def read_json(self, path: str, name: Optional[str] = None) -> DataFrame:
+        from .io.sources import JsonSource
+        return DataFrame(self, L.Scan(JsonSource(path, name)))
+
     def sql(self, query: str) -> DataFrame:
         from .sql.parser import parse_sql
         plan = parse_sql(query, self)

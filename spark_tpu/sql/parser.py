@@ -670,6 +670,45 @@ class Parser:
         raise ParseError(f"unknown function {name!r}")
 
 
+def _inner_alias_of(sub: "_Select") -> str:
+    ref, alias = sub.relations[0]
+    return alias or (ref if isinstance(ref, str) else "__sub")
+
+
+def _classify_side(e: Expression, inner_alias: str,
+                   inner_names) -> str:
+    """'inner' | 'outer' | 'mixed' | 'none' for a subquery conjunct,
+    honoring table qualifiers (references() drops them, which
+    misclassified `bounds.k = tiny.k`-style correlation)."""
+    saw_inner = saw_outer = False
+
+    def walk(node):
+        nonlocal saw_inner, saw_outer
+        if isinstance(node, _QualifiedRef):
+            if node.qualifier == inner_alias and node.col in inner_names:
+                saw_inner = True
+            else:
+                saw_outer = True
+            return
+        if isinstance(node, ColumnRef):
+            if node.name() in inner_names:
+                saw_inner = True
+            else:
+                saw_outer = True
+            return
+        for c in node.children:
+            walk(c)
+
+    walk(e)
+    if saw_inner and saw_outer:
+        return "mixed"
+    if saw_inner:
+        return "inner"
+    if saw_outer:
+        return "outer"
+    return "none"
+
+
 class _SubqueryExpr(Expression):
     """Base for parse-time subquery expressions; consumed by the
     Lowerer's rewrite passes (reference: `optimizer/subquery.scala`
@@ -1312,44 +1351,14 @@ class Lowerer:
                 "GROUP BY/HAVING/ORDER BY/LIMIT inside a correlated "
                 "predicate subquery is not supported")
         ref, alias = sub.relations[0]
-        inner_alias = alias or (ref if isinstance(ref, str) else "__sub")
+        inner_alias = _inner_alias_of(sub)
         inner_plan = self._rel_plan(ref)
         inner_scope = _Scope()
         inner_scope.add(inner_alias, inner_plan.schema().names)
         inner_names = set(inner_plan.schema().names)
 
         def side(e: Expression) -> str:
-            """'inner' | 'outer' | 'mixed' | 'none', honoring qualifiers
-            (references() drops them, which misclassified
-            `bounds.k = tiny.k`-style correlation)."""
-            saw_inner = saw_outer = False
-
-            def walk(node):
-                nonlocal saw_inner, saw_outer
-                if isinstance(node, _QualifiedRef):
-                    if node.qualifier == inner_alias and \
-                            node.col in inner_names:
-                        saw_inner = True
-                    else:
-                        saw_outer = True
-                    return
-                if isinstance(node, ColumnRef):
-                    if node.name() in inner_names:
-                        saw_inner = True
-                    else:
-                        saw_outer = True
-                    return
-                for c in node.children:
-                    walk(c)
-
-            walk(e)
-            if saw_inner and saw_outer:
-                return "mixed"
-            if saw_inner:
-                return "inner"
-            if saw_outer:
-                return "outer"
-            return "none"
+            return _classify_side(e, inner_alias, inner_names)
 
         local, pairs = [], []
         for c in _conjuncts(sub.where):
@@ -1402,6 +1411,13 @@ class Lowerer:
                           [ColumnRef(out_cols[0])], how)
 
         if isinstance(e, _ExistsSubquery):
+            if any(_contains_agg(ie) for ie, _a in (e.select.items or [])):
+                # `EXISTS (SELECT count(*) ...)` is ALWAYS true (the
+                # aggregate yields one row); a semi-join would wrongly
+                # drop non-matching outer rows
+                raise AnalysisError(
+                    "aggregates inside an EXISTS subquery are not "
+                    "supported (the aggregate always yields one row)")
             ref, _alias, local, pairs = self._split_correlation(
                 e.select, scope)
             if not pairs:
@@ -1424,20 +1440,13 @@ class Lowerer:
             if not (sub.relations and len(sub.relations) == 1
                     and not sub.joins):
                 return False
-            ref, alias = sub.relations[0]
-            inner_alias = alias or (ref if isinstance(ref, str)
-                                    else "__sub")
-            inner_names = set(self._rel_plan(ref).schema().names)
-
-            def outer_ref(e) -> bool:
-                if isinstance(e, _QualifiedRef):
-                    return not (e.qualifier == inner_alias
-                                and e.col in inner_names)
-                if isinstance(e, ColumnRef):
-                    return e.name() not in inner_names
-                return any(outer_ref(k) for k in e.children)
-
-            return any(outer_ref(cc) for cc in _conjuncts(sub.where))
+            inner_alias = _inner_alias_of(sub)
+            inner_names = set(
+                self._rel_plan(sub.relations[0][0]).schema().names)
+            return any(
+                _classify_side(cc, inner_alias, inner_names)
+                in ("outer", "mixed")
+                for cc in _conjuncts(sub.where))
 
         def rewrite(e: Expression) -> Expression:
             nonlocal plan

@@ -1,0 +1,64 @@
+"""CSV/JSON sources and the device sketch kernels (reference:
+csv/json FileFormats + common/sketch)."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu.functions import col
+from spark_tpu import functions as F
+
+
+def test_read_csv(session, tmp_path):
+    p = tmp_path / "t.csv"
+    pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "x"],
+                  "c": [1.5, 2.5, 3.5]}).to_csv(p, index=False)
+    got = (session.read_csv(str(p)).filter(col("a") >= 2)
+           .to_pandas())
+    assert got["a"].tolist() == [2, 3]
+    assert got["b"].tolist() == ["y", "x"]
+
+
+def test_read_csv_delimiter(session, tmp_path):
+    p = tmp_path / "t2.csv"
+    p.write_text("a|b\n1|x\n2|y\n")
+    got = session.read_csv(str(p), sep="|").to_pandas()
+    assert got["a"].tolist() == [1, 2]
+
+
+def test_read_json(session, tmp_path):
+    p = tmp_path / "t.jsonl"
+    with open(p, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"k": i, "s": f"v{i % 2}"}) + "\n")
+    got = (session.read_json(str(p))
+           .group_by(col("s")).agg(F.count().alias("c"))
+           .sort(col("s")).to_pandas())
+    assert got["c"].tolist() == [3, 2]
+
+
+def test_bloom_filter(session):
+    import jax.numpy as jnp
+    pdf = pd.DataFrame({"k": np.arange(0, 2000, 2).astype(np.int64)})
+    session.register_table("bf_t", pdf)
+    bf = session.table("bf_t").stat.bloom_filter("k", 1000, fpp=0.01)
+    probe = jnp.arange(2000, dtype=jnp.int64)
+    got = np.asarray(bf.might_contain(probe))
+    # no false negatives
+    assert got[::2].all()
+    # false positive rate near target
+    assert got[1::2].mean() < 0.05
+
+
+def test_count_min_sketch(session):
+    import jax.numpy as jnp
+    vals = np.repeat(np.arange(50, dtype=np.int64), np.arange(1, 51))
+    session.register_table("cms_t", pd.DataFrame({"k": vals}))
+    cms = session.table("cms_t").stat.count_min_sketch("k", eps=0.001)
+    est = np.asarray(cms.estimate(jnp.arange(50, dtype=jnp.int64)))
+    true = np.arange(1, 51)
+    # CMS never underestimates; slack bounded by eps * total
+    assert (est >= true).all()
+    assert (est <= true + 0.001 * vals.size + 1).all()

@@ -332,3 +332,12 @@ def test_scalar_subquery_multi_column_raises(bounds):
         bounds.sql(
             "SELECT v FROM t2 WHERE v > (SELECT lo, hi FROM bounds)"
         ).to_pandas()
+
+
+def test_exists_with_aggregate_raises(bounds):
+    from spark_tpu.expr import AnalysisError
+    with pytest.raises(AnalysisError, match="aggregates inside"):
+        bounds.sql("""
+            SELECT v FROM t2
+            WHERE EXISTS (SELECT count(*) FROM bounds WHERE bk = k)
+        """).to_pandas()
