@@ -158,6 +158,19 @@ PROFILE_DIR = register(
     doc="When set, wrap query execution in a jax.profiler trace written "
         "to this directory (one trace per execute).")
 
+EVENT_LOG_DIR = register(
+    "spark_tpu.sql.eventLog.dir", "",
+    doc="When set, append one JSON line per query execution (plan "
+        "fingerprint, phase timings, per-operator metrics) to "
+        "<dir>/app-<pid>.jsonl — the EventLoggingListener analog; read "
+        "back with spark_tpu.history.read_event_log.")
+
+CHECKPOINT_DIR = register(
+    "spark_tpu.sql.checkpoint.dir", "",
+    doc="Directory for df.checkpoint(): when set, checkpoints write "
+        "Parquet (survive the process, ReliableCheckpointRDD analog); "
+        "otherwise they materialize in memory (localCheckpoint).")
+
 CLUSTER_COORDINATOR = register(
     "spark_tpu.sql.cluster.coordinator", "",
     doc="host:port of the jax.distributed coordinator for multi-host "

@@ -223,6 +223,36 @@ class DataFrame:
         self.session.uncache(self.plan)
         return self
 
+    def checkpoint(self) -> "DataFrame":
+        """Materialize and truncate lineage (reference: RDD.checkpoint /
+        Dataset.checkpoint). With spark_tpu.sql.checkpoint.dir set, the
+        result persists as Parquet (ReliableCheckpointRDD analog) and the
+        returned frame scans it from disk; otherwise it is held in
+        memory (localCheckpoint)."""
+        import os
+        import uuid
+
+        ckpt_dir = str(self.session.conf.get("spark_tpu.sql.checkpoint.dir"))
+        if ckpt_dir:
+            path = os.path.join(ckpt_dir, f"ckpt-{uuid.uuid4().hex[:12]}")
+            self.write.parquet(path)
+            return self.session.read_parquet(path)
+        return self.local_checkpoint()
+
+    def local_checkpoint(self) -> "DataFrame":
+        """In-memory materialization + lineage truncation (reference:
+        Dataset.localCheckpoint — never reliable, ignores checkpoint.dir).
+        The source name is unique per call: the fingerprint-keyed data
+        cache would otherwise cross-match distinct checkpoints."""
+        import uuid
+
+        from .io.sources import ArrowTableSource
+        table = self.collect()
+        name = f"__checkpoint_{uuid.uuid4().hex[:12]}__"
+        return self._with(L.Scan(ArrowTableSource(name, table)))
+
+    localCheckpoint = local_checkpoint
+
     def to_pandas(self):
         return self.collect().to_pandas()
 

@@ -388,7 +388,35 @@ class QueryExecution:
         if fp in self.session._cache_requests and \
                 fp not in self.session._data_cache:
             self.session._data_cache[fp] = batch.to_arrow()
+        self._log_event(root)
         return batch, flags, metrics
+
+    def _log_event(self, root: P.PhysicalPlan) -> None:
+        """Append one JSON line per execution when eventLog.dir is set
+        (the `EventLoggingListener.scala:50` event-stream analog; replay
+        with spark_tpu.history.read_event_log)."""
+        log_dir = str(self.session.conf.get("spark_tpu.sql.eventLog.dir"))
+        if not log_dir:
+            return
+        import json
+        import os
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            event = {
+                "ts": time.time(),
+                "plan": root.describe(),
+                "phase_times_s": {k: round(v, 4)
+                                  for k, v in self.phase_times.items()},
+                "metrics": self.last_metrics,
+            }
+            path = os.path.join(log_dir, f"app-{os.getpid()}.jsonl")
+            with open(path, "a") as f:
+                f.write(json.dumps(event) + "\n")
+        except OSError as e:
+            # never fail a completed query over observability I/O
+            # (the reference's listener logs and continues likewise)
+            import warnings
+            warnings.warn(f"event log write failed: {e}")
 
     def collect(self) -> pa.Table:
         batch, _, _ = self.execute_batch()

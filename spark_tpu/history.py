@@ -1,0 +1,39 @@
+"""Event-log replay: the HistoryServer analog, sized to this engine.
+
+The reference persists a typed event stream (`EventLoggingListener.scala`)
+and rebuilds UI state by replay (`HistoryServer.scala:50` +
+`ReplayListenerBus`). Here each query execution appends one JSON line
+(plan fingerprint, phase timings, per-operator metrics) and replay is a
+DataFrame over those lines — queryable with the engine itself or pandas.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional
+
+import pandas as pd
+
+
+def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
+    """All logged query executions as a flat DataFrame (one row per
+    execution: ts, plan, per-phase seconds, metric columns)."""
+    pattern = os.path.join(log_dir, f"app-{app or '*'}.jsonl")
+    rows: List[dict] = []
+    for path in sorted(glob.glob(pattern)):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                e = json.loads(line)
+                row = {"ts": e.get("ts"), "plan": e.get("plan"),
+                       "app": os.path.basename(path)}
+                for k, v in (e.get("phase_times_s") or {}).items():
+                    row[f"phase_{k}_s"] = v
+                for k, v in (e.get("metrics") or {}).items():
+                    row[k] = v
+                rows.append(row)
+    return pd.DataFrame(rows)

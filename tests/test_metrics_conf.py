@@ -77,3 +77,58 @@ def test_per_op_metrics_disable(session):
         assert not any(k.startswith("rows_") for k in qe.last_metrics)
     finally:
         session.conf.set("spark_tpu.sql.metrics.enabled", True)
+
+
+def test_event_log_and_history(session, tmp_path):
+    log_dir = str(tmp_path / "events")
+    session.conf.set("spark_tpu.sql.eventLog.dir", log_dir)
+    try:
+        session.range(100).filter(col("id") > 50).to_pandas()
+        session.range(10).to_pandas()
+    finally:
+        session.conf.set("spark_tpu.sql.eventLog.dir", "")
+    from spark_tpu.history import read_event_log
+    df = read_event_log(log_dir)
+    assert len(df) == 2
+    assert "phase_execution_s" in df.columns
+    assert df["plan"].str.contains("RangeExec").all()
+
+
+def test_checkpoint_truncates_lineage(session, tmp_path):
+    df = session.range(50).filter(col("id") % 2 == 0)
+    ck = df.checkpoint()
+    from spark_tpu.plan.logical import Scan
+    assert isinstance(ck.plan, Scan)
+    assert ck.to_pandas()["id"].tolist() == list(range(0, 50, 2))
+    # reliable variant writes parquet
+    session.conf.set("spark_tpu.sql.checkpoint.dir", str(tmp_path / "ck"))
+    try:
+        ck2 = session.range(10).checkpoint()
+    finally:
+        session.conf.set("spark_tpu.sql.checkpoint.dir", "")
+    assert ck2.to_pandas()["id"].tolist() == list(range(10))
+
+
+def test_checkpoint_fingerprints_unique(session):
+    """Code-review: shared '__checkpoint__' names cross-matched in the
+    fingerprint-keyed data cache."""
+    a = session.range(10).checkpoint()
+    b = session.range(20).checkpoint()
+    a.cache()
+    assert len(a.to_pandas()) == 10
+    assert len(b.to_pandas()) == 20
+    a.unpersist()
+
+
+def test_event_log_failure_does_not_break_query(session, tmp_path):
+    bad = tmp_path / "afile"
+    bad.write_text("x")
+    session.conf.set("spark_tpu.sql.eventLog.dir", str(bad))
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = session.range(5).to_pandas()
+        assert len(out) == 5
+    finally:
+        session.conf.set("spark_tpu.sql.eventLog.dir", "")
