@@ -223,6 +223,30 @@ class DataFrame:
         self.session.uncache(self.plan)
         return self
 
+    def write_stream(self, checkpoint_dir: str,
+                     output_mode: str = "complete"):
+        """Start a micro-batch streaming query over this plan (the plan
+        must contain one streaming source; reference:
+        DataStreamWriter.start -> MicroBatchExecution)."""
+        from .streaming import StreamingQuery, _StreamSource
+        streams = []
+
+        def walk(n):
+            if isinstance(n, _StreamSource):
+                streams.append(n.stream)
+            for c in n.children:
+                walk(c)
+
+        walk(self.plan)
+        if len(streams) != 1:
+            raise AnalysisError(
+                f"write_stream needs exactly one streaming source "
+                f"(found {len(streams)})")
+        return StreamingQuery(self.session, self.plan, streams[0],
+                              checkpoint_dir, output_mode)
+
+    writeStream = write_stream
+
     def checkpoint(self) -> "DataFrame":
         """Materialize and truncate lineage (reference: RDD.checkpoint /
         Dataset.checkpoint). With spark_tpu.sql.checkpoint.dir set, the

@@ -1,0 +1,361 @@
+"""Structured streaming: the micro-batch execution loop.
+
+A scaled-to-this-engine implementation of the reference's structured
+streaming core (`execution/streaming/MicroBatchExecution.scala:39`,
+`StreamExecution.scala:69`): a host-driven loop that
+
+1. polls sources for their latest offsets and WRITES THE PLANNED RANGE
+   to the offset log BEFORE executing (`offsetLog:219`, an
+   `HDFSMetadataLog` analog — JSON files named by batch id);
+2. runs the query over exactly the logged range — stateless plans
+   execute the batch slice through the normal engine; streaming
+   aggregations fold the slice into versioned accumulator tables (the
+   `StateStore:101` role is played by the direct-aggregate tables that
+   already power batch streaming);
+3. commits to the commit log (`commitLog:226`) and emits to the sink.
+
+Exactly-once = offset log ∧ commit log ∧ versioned state: on restart,
+a planned-but-uncommitted batch re-runs over the SAME logged range
+against the last committed state version, so replays are idempotent.
+
+The TPU angle: each micro-batch is one jitted SPMD program over a
+statically-shaped batch slice; state lives in HBM as accumulator tables
+between triggers (no RocksDB tier — state is bounded by the aggregate's
+padded domain, and the host checkpoint serializes it as numpy).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from . import functions as F  # noqa: F401  (user convenience re-export)
+from .columnar import Batch
+from .plan import logical as L
+
+
+class MemoryStream:
+    """An appendable in-memory source (the reference's `MemoryStream` —
+    the deterministic test source behind StreamTest.scala:342)."""
+
+    def __init__(self, session, schema_df: pd.DataFrame):
+        self.session = session
+        self._table = pa.Table.from_pandas(schema_df.iloc[0:0],
+                                           preserve_index=False)
+        self._batches: List[pa.Table] = []
+
+    def add_data(self, df: pd.DataFrame) -> None:
+        self._batches.append(pa.Table.from_pandas(df, preserve_index=False))
+
+    addData = add_data
+
+    def latest_offset(self) -> int:
+        return len(self._batches)
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        tables = self._batches[start:end]
+        if not tables:
+            return self._table
+        return pa.concat_tables(tables)
+
+    def to_df(self):
+        """A DataFrame over a placeholder scan; the streaming loop swaps
+        the placeholder for each micro-batch's slice (the reference's
+        logical-plan rewrite in `MicroBatchExecution.runBatch:525`)."""
+        from .dataframe import DataFrame
+        return DataFrame(self.session, _StreamSource(self))
+
+
+class _StreamSource(L.LeafPlan):
+    """Logical placeholder for a streaming source."""
+
+    def __init__(self, stream: MemoryStream):
+        self.stream = stream
+        self.children = ()
+
+    def schema(self):
+        from .io.sources import ArrowTableSource
+        return ArrowTableSource("__stream__", self.stream._table).schema()
+
+    def simple_string(self):
+        return "StreamSource(memory)"
+
+
+class _MetadataLog:
+    """Numbered JSON files with atomic rename — the
+    `HDFSMetadataLog`/`CheckpointFileManager` contract in miniature."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def latest(self):
+        ids = [int(f) for f in os.listdir(self.path) if f.isdigit()]
+        if not ids:
+            return None, None
+        i = max(ids)
+        with open(os.path.join(self.path, str(i))) as f:
+            return i, json.load(f)
+
+    def add(self, batch_id: int, payload: dict) -> None:
+        final = os.path.join(self.path, str(batch_id))
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, final)
+
+
+class StreamingQuery:
+    """One micro-batch query (reference: StreamExecution). Trigger is
+    manual (`process_available()`) — the deterministic single-step mode
+    StreamTest uses; a wall-clock trigger is a loop around it."""
+
+    def __init__(self, session, plan: L.LogicalPlan, stream: MemoryStream,
+                 checkpoint_dir: str, output_mode: str = "complete"):
+        if output_mode not in ("complete", "append"):
+            raise ValueError(f"unsupported outputMode {output_mode!r}")
+        self.session = session
+        self.plan = plan
+        self.stream = stream
+        self.output_mode = output_mode
+        self.offset_log = _MetadataLog(os.path.join(checkpoint_dir,
+                                                    "offsets"))
+        self.commit_log = _MetadataLog(os.path.join(checkpoint_dir,
+                                                    "commits"))
+        self._state_dir = os.path.join(checkpoint_dir, "state")
+        os.makedirs(self._state_dir, exist_ok=True)
+        self._agg = self._find_aggregate(plan)
+        if self._agg is not None and output_mode == "append":
+            # the reference rejects append-without-watermark for
+            # aggregations at analysis time; silently re-emitting every
+            # group each trigger would duplicate sink rows
+            raise ValueError(
+                "outputMode='append' on a streaming aggregation is not "
+                "supported (no watermark support); use 'complete'")
+        self._results: List[pd.DataFrame] = []
+        self._tables = None      # carried aggregate state (device)
+        self._prep = None
+        self._recover()
+
+    # -- plan shape ---------------------------------------------------------
+
+    @staticmethod
+    def _find_aggregate(plan: L.LogicalPlan) -> Optional[L.Aggregate]:
+        """The single streaming aggregate, if any (stateless otherwise).
+        Nested/multiple aggregates are out of scope, as in the
+        reference's UnsupportedOperationChecker."""
+        aggs: List[L.Aggregate] = []
+
+        def walk(n):
+            if isinstance(n, L.Aggregate):
+                aggs.append(n)
+            for c in n.children:
+                walk(c)
+
+        walk(plan)
+        if len(aggs) > 1:
+            raise ValueError("multiple streaming aggregates unsupported")
+        return aggs[0] if aggs else None
+
+    # -- recovery -----------------------------------------------------------
+
+    def _recover(self) -> None:
+        """Restart semantics: resume state at the last COMMITTED batch;
+        a planned-but-uncommitted offset entry will re-run over its
+        logged range (idempotent because state is versioned)."""
+        last_commit, _ = self.commit_log.latest()
+        self._committed_batch = -1 if last_commit is None else last_commit
+        if self._agg is not None and last_commit is not None:
+            self._load_state(last_commit)
+
+    def _state_path(self, batch_id: int) -> str:
+        return os.path.join(self._state_dir, f"v{batch_id}.npz")
+
+    def _save_state(self, batch_id: int) -> None:
+        cnt, accs = self._tables
+        flat = {"cnt": np.asarray(cnt)}
+        for i, row in enumerate(accs):
+            for j, a in enumerate(row):
+                flat[f"acc_{i}_{j}"] = np.asarray(a)
+        tmp = self._state_path(batch_id) + ".tmp.npz"
+        np.savez(tmp, **flat)
+        os.replace(tmp, self._state_path(batch_id))
+
+    def _load_state(self, batch_id: int) -> None:
+        self._ensure_prep()
+        with np.load(self._state_path(batch_id)) as z:
+            cnt = jnp.asarray(z["cnt"])
+            accs = []
+            i = 0
+            while f"acc_{i}_0" in z:
+                row = []
+                j = 0
+                while f"acc_{i}_{j}" in z:
+                    row.append(jnp.asarray(z[f"acc_{i}_{j}"]))
+                    j += 1
+                accs.append(row)
+                i += 1
+        self._tables = (cnt, accs)
+
+    # -- execution ----------------------------------------------------------
+
+    def _ensure_prep(self):
+        if self._prep is not None or self._agg is None:
+            return
+        from .io.sources import ArrowTableSource
+        from .plan.planner import plan_physical
+        import spark_tpu.plan.physical as P
+
+        def swap(n):
+            if isinstance(n, _StreamSource):
+                return L.Scan(ArrowTableSource("__stream_probe__",
+                                               self.stream._table))
+            return None
+
+        phys = plan_physical(self.plan.transform_down(swap),
+                             self.session.conf)
+
+        agg_exec = None
+
+        def walk(n):
+            nonlocal agg_exec
+            if isinstance(n, P.HashAggregateExec) and agg_exec is None:
+                agg_exec = n
+            for c in n.children:
+                walk(c)
+
+        walk(phys)
+        if agg_exec is None:
+            raise ValueError("aggregate lost during planning")
+        self._agg_exec = agg_exec
+
+        def unary_path(root, target):
+            """Operators from (under) `root` down to `target`, refusing
+            non-unary nodes (stream-static joins are unsupported — fail
+            with a named error, not an unpack crash)."""
+            path = []
+            node = root
+            while node is not target:
+                if len(node.children) != 1:
+                    from .expr import AnalysisError
+                    raise AnalysisError(
+                        f"streaming aggregation supports a single unary "
+                        f"operator chain; {type(node).__name__} "
+                        f"(e.g. a stream-static join) is not supported")
+                path.append(node)
+                node = node.children[0]
+            return path
+
+        # operators ABOVE the aggregate (HAVING filters, projections,
+        # sort/limit) re-apply to each trigger's finalized table;
+        # operators BELOW replay per micro-batch slice
+        self._above = unary_path(phys, agg_exec)
+        chain = []
+        node = agg_exec.children[0]
+        while node.children:
+            if len(node.children) != 1:
+                from .expr import AnalysisError
+                raise AnalysisError(
+                    f"streaming aggregation supports a single unary "
+                    f"operator chain below the aggregate; "
+                    f"{type(node).__name__} is not supported")
+            chain.append(node)
+            node = node.children[0]
+        self._chain = chain
+        from .plan.physical import ExecContext
+        probe = self._batch_for(self.stream.slice(0, 0))
+        ctx = ExecContext(self.session.conf)
+        replayed = probe
+        for op in reversed(chain):
+            replayed = op.compute(ctx, [replayed])
+        prep = agg_exec.prepare_direct(replayed, self.session.conf)
+        if prep is None:
+            raise ValueError(
+                "streaming aggregation requires a statically-bounded "
+                "group domain (dictionary / pmod keys)")
+        self._prep = prep
+
+    def _batch_for(self, table: pa.Table) -> Batch:
+        return Batch.from_arrow(table)
+
+    def process_available(self) -> None:
+        """Run micro-batches until the source is drained (the
+        `Trigger.AvailableNow` analog; each iteration = one batch of the
+        `MicroBatchExecution` loop)."""
+        while True:
+            batch_id = self._committed_batch + 1
+            planned_id, planned = self.offset_log.latest()
+            if planned_id is not None and planned_id == batch_id:
+                # planned but not committed (crash between the logs):
+                # replay exactly the logged range
+                start, end = planned["start"], planned["end"]
+            else:
+                start = planned["end"] if planned is not None else 0
+                end = self.stream.latest_offset()
+                if end <= start:
+                    return  # drained
+                self.offset_log.add(batch_id, {"start": start, "end": end})
+            self._run_batch(batch_id, start, end)
+            self.commit_log.add(batch_id, {"ok": True})
+            self._committed_batch = batch_id
+
+    processAllAvailable = process_available
+
+    def _run_batch(self, batch_id: int, start: int, end: int) -> None:
+        table = self.stream.slice(start, end)
+        if self._agg is None:
+            # stateless: swap the stream placeholder for this slice and
+            # run the normal engine
+            from .io.sources import ArrowTableSource
+
+            def swap(n):
+                if isinstance(n, _StreamSource):
+                    return L.Scan(ArrowTableSource(
+                        f"__microbatch_{batch_id}__", table))
+                return None
+
+            from .execution.executor import QueryExecution
+            out = QueryExecution(
+                self.session, self.plan.transform_down(swap)).collect()
+            self._results.append(out.to_pandas())
+            return
+        # stateful: fold the slice into carried accumulator tables
+        self._ensure_prep()
+        from .plan.physical import ExecContext
+        if self._tables is None:
+            self._tables = self._agg_exec.direct_init_tables(self._prep)
+        if table.num_rows:
+            b = self._batch_for(table)
+            ctx = ExecContext(self.session.conf)
+            for op in reversed(self._chain):
+                b = op.compute(ctx, [b])
+            self._tables = self._agg_exec.direct_update_tables(
+                self._tables, b, self._prep)
+        self._save_state(batch_id)
+        out = self._agg_exec.direct_finalize_tables(self._tables,
+                                                    self._prep)
+        from .plan.physical import ExecContext
+        ctx = ExecContext(self.session.conf)
+        for op in reversed(self._above):
+            out = op.compute(ctx, [out])
+        self._results.append(out.to_arrow().to_pandas())
+
+    # -- sink ---------------------------------------------------------------
+
+    def latest(self) -> Optional[pd.DataFrame]:
+        """Memory sink: the latest result table (complete mode) or the
+        last appended slice."""
+        return self._results[-1] if self._results else None
+
+    def results(self) -> List[pd.DataFrame]:
+        return list(self._results)
+
+    def stop(self) -> None:
+        pass  # manual trigger: nothing running between calls

@@ -221,12 +221,12 @@ def _cached(qname: str, fn):
             if f.endswith(".parquet"):
                 stamp = max(stamp, os.path.getmtime(os.path.join(path, f)))
         cache = os.path.join(path, "golden_cache",
-                             f"{qname}-{int(stamp)}.parquet")
+                             f"{qname}-{int(stamp * 1e6)}.parquet")
         if os.path.exists(cache):
             return pq.read_table(cache).to_pandas()
         out = fn(path)
         os.makedirs(os.path.dirname(cache), exist_ok=True)
-        tmp = cache + ".tmp"
+        tmp = f"{cache}.{os.getpid()}.tmp"  # per-process: concurrent-safe
         pq.write_table(pa.Table.from_pandas(out, preserve_index=False),
                        tmp)
         os.replace(tmp, cache)  # atomic: no truncated caches on Ctrl-C
