@@ -161,6 +161,9 @@ class DataFrame:
 
     def drop_duplicates(self, subset: Optional[Sequence[str]] = None
                         ) -> "DataFrame":
+        """Keep one row per key (an arbitrary one, like the reference's
+        Deduplicate): row_number over a window partitioned on the subset,
+        filtered to 1."""
         if subset is None:
             return self.distinct()
         missing = [n for n in subset if n not in self.plan.schema().names]
@@ -168,10 +171,16 @@ class DataFrame:
             raise AnalysisError(f"dropDuplicates: unknown columns {missing}")
         if set(subset) == set(self.plan.schema().names):
             return self.distinct()
-        raise AnalysisError(
-            "dropDuplicates on a column subset needs first()-style "
-            "aggregates (not supported yet); use distinct() or aggregate "
-            "explicitly")
+        from .window import Window, row_number
+        w = Window.partition_by(*[ColumnRef(n) for n in subset]) \
+            .order_by(ColumnRef(subset[0]))
+        keep_cols = self.plan.schema().names
+        rn = "__rn"
+        while rn in keep_cols:  # never clobber a real column
+            rn = "_" + rn
+        return (self.with_column(rn, row_number().over(w))
+                .filter(ColumnRef(rn) == 1)
+                .select(*[ColumnRef(n) for n in keep_cols]))
 
     dropDuplicates = drop_duplicates
 

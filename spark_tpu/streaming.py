@@ -178,8 +178,8 @@ class StreamingQuery:
     def _state_path(self, batch_id: int) -> str:
         return os.path.join(self._state_dir, f"v{batch_id}.npz")
 
-    def _save_state(self, batch_id: int) -> None:
-        cnt, accs = self._tables
+    def _save_state(self, batch_id: int, tables) -> None:
+        cnt, accs = tables
         flat = {"cnt": np.asarray(cnt)}
         for i, row in enumerate(accs):
             for j, a in enumerate(row):
@@ -275,12 +275,33 @@ class StreamingQuery:
         replayed = probe
         for op in reversed(chain):
             replayed = op.compute(ctx, [replayed])
+        from . import types as T
+        base = agg_exec.child.schema()
+        for g in agg_exec.group_exprs:
+            if isinstance(g.dtype(base), T.StringType):
+                # the prep is built from an empty probe slice, so
+                # per-batch dictionary codes would never share an
+                # encoding across triggers — unsupported, not broken
+                raise ValueError(
+                    "string group keys are not supported in streaming "
+                    "aggregations (per-batch dictionaries have no "
+                    "stable shared encoding)")
         prep = agg_exec.prepare_direct(replayed, self.session.conf)
         if prep is None:
             raise ValueError(
                 "streaming aggregation requires a statically-bounded "
-                "group domain (dictionary / pmod keys)")
+                "integer group domain (e.g. pmod keys)")
         self._prep = prep
+
+        def update(tables, b):
+            ctx = ExecContext(self.session.conf)
+            for op in reversed(self._chain):
+                b = op.compute(ctx, [b])
+            return self._agg_exec.direct_update_tables(tables, b, prep)
+
+        # one jitted step per trigger (no donation: a save failure must
+        # leave the PRE-update tables alive for an exact replay)
+        self._update = jax.jit(update)
 
     def _batch_for(self, table: pa.Table) -> Batch:
         return Batch.from_arrow(table)
@@ -305,6 +326,25 @@ class StreamingQuery:
             self._run_batch(batch_id, start, end)
             self.commit_log.add(batch_id, {"ok": True})
             self._committed_batch = batch_id
+            self._prune(batch_id)
+
+    def _prune(self, committed: int, retain: int = 2) -> None:
+        """Drop state versions and log entries older than the retained
+        window (the reference's minBatchesToRetain); recovery only ever
+        reads the last committed version."""
+        floor = committed - retain
+        for log in (self.offset_log, self.commit_log):
+            for f in os.listdir(log.path):
+                if f.isdigit() and int(f) < floor:
+                    os.remove(os.path.join(log.path, f))
+        for f in os.listdir(self._state_dir):
+            if f.startswith("v") and f.endswith(".npz"):
+                try:
+                    vid = int(f[1:-4])
+                except ValueError:
+                    continue
+                if vid < floor:
+                    os.remove(os.path.join(self._state_dir, f))
 
     processAllAvailable = process_available
 
@@ -316,9 +356,12 @@ class StreamingQuery:
             from .io.sources import ArrowTableSource
 
             def swap(n):
+                # constant name: the compiled-stage cache keys on the
+                # plan fingerprint incl. source.name, so one jitted
+                # program serves every trigger
                 if isinstance(n, _StreamSource):
-                    return L.Scan(ArrowTableSource(
-                        f"__microbatch_{batch_id}__", table))
+                    return L.Scan(ArrowTableSource("__microbatch__",
+                                                   table))
                 return None
 
             from .execution.executor import QueryExecution
@@ -328,17 +371,16 @@ class StreamingQuery:
             return
         # stateful: fold the slice into carried accumulator tables
         self._ensure_prep()
-        from .plan.physical import ExecContext
         if self._tables is None:
             self._tables = self._agg_exec.direct_init_tables(self._prep)
+        new_tables = self._tables
         if table.num_rows:
-            b = self._batch_for(table)
-            ctx = ExecContext(self.session.conf)
-            for op in reversed(self._chain):
-                b = op.compute(ctx, [b])
-            self._tables = self._agg_exec.direct_update_tables(
-                self._tables, b, self._prep)
-        self._save_state(batch_id)
+            new_tables = self._update(self._tables, self._batch_for(table))
+        # persist BEFORE adopting: a save failure must leave the
+        # pre-update tables in place so an in-process retry replays the
+        # same range without double-counting
+        self._save_state(batch_id, new_tables)
+        self._tables = new_tables
         out = self._agg_exec.direct_finalize_tables(self._tables,
                                                     self._prep)
         from .plan.physical import ExecContext

@@ -235,3 +235,29 @@ def _cached(qname: str, fn):
 
 
 GOLDEN = {k: _cached(k, v) for k, v in GOLDEN.items()}
+
+
+def q10(path: str) -> pd.DataFrame:
+    c = _read(path, "customer")
+    o = _read(path, "orders")
+    l = _read(path, "lineitem")
+    n = _read(path, "nation")
+    o = o[(o["o_orderdate"] >= pd.Timestamp("1993-10-01").date())
+          & (o["o_orderdate"] < pd.Timestamp("1994-01-01").date())]
+    l = l[l["l_returnflag"] == "R"]
+    m = (l.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(n, left_on="c_nationkey", right_on="n_nationkey"))
+    m = m.assign(revenue=m["l_extendedprice"] * (1 - m["l_discount"]))
+    out = (m.groupby(["c_custkey", "c_name", "c_acctbal", "n_name",
+                      "c_address", "c_phone", "c_comment"], as_index=False)
+           .agg(revenue=("revenue", "sum"))
+           .sort_values(["revenue", "c_custkey"],
+                        ascending=[False, True]).head(20))
+    cols = ["c_custkey", "c_name", "revenue", "c_acctbal", "n_name",
+            "c_address", "c_phone", "c_comment"]
+    return out[cols].reset_index(drop=True)
+
+
+GOLDEN_RAW_Q10 = q10
+GOLDEN["q10"] = _cached("q10", q10)

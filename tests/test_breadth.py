@@ -153,3 +153,13 @@ def test_sql_select_distinct(data):
     got = session.sql("SELECT DISTINCT k FROM breadth ORDER BY k") \
         .to_pandas()
     assert got["k"].tolist() == sorted(pdf["k"].unique().tolist())
+
+
+def test_drop_duplicates_subset(data):
+    session, pdf = data
+    got = (session.table("breadth").drop_duplicates(["k"])
+           .to_pandas())
+    assert sorted(got["k"].tolist()) == sorted(pdf["k"].unique().tolist())
+    # kept rows are real rows of the input
+    merged = got.merge(pdf, on=list(got.columns), how="left", indicator=True)
+    assert (merged["_merge"] == "both").all()

@@ -341,3 +341,10 @@ def test_exists_with_aggregate_raises(bounds):
             SELECT v FROM t2
             WHERE EXISTS (SELECT count(*) FROM bounds WHERE bk = k)
         """).to_pandas()
+
+
+def test_tpch_q10(sql_session):
+    got = _norm(sql_session.sql(SQL_QUERIES["q10"]).to_pandas())
+    want = G.GOLDEN["q10"](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)

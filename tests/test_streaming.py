@@ -140,3 +140,28 @@ def test_stream_static_join_rejected(session, tmp_path):
     src.add_data(pd.DataFrame({"k": [1], "v": [1]}))
     with pytest.raises(AnalysisError, match="join|unary"):
         q.process_available()
+
+
+def test_string_group_key_rejected(session, tmp_path):
+    src = MemoryStream(session, pd.DataFrame(
+        {"s": pd.Series([], dtype=str), "v": pd.Series([], dtype=np.int64)}))
+    q = (src.to_df().group_by(col("s")).agg(F.count().alias("c"))
+         .write_stream(str(tmp_path / "cks")))
+    src.add_data(pd.DataFrame({"s": ["a"], "v": [1]}))
+    with pytest.raises(ValueError, match="string group keys"):
+        q.process_available()
+
+
+def test_checkpoint_pruning(session, tmp_path):
+    import os
+    ck = str(tmp_path / "ckp")
+    src = MemoryStream(session, _schema_df())
+    q = (src.to_df().group_by(F.pmod(col("k"), 3).alias("g"))
+         .agg(F.count().alias("c")).write_stream(ck))
+    for i in range(6):
+        src.add_data(pd.DataFrame({"k": [i], "v": [i]}))
+        q.process_available()
+    states = os.listdir(os.path.join(ck, "state"))
+    assert len(states) <= 3, states
+    out = q.latest()
+    assert out["c"].sum() == 6
