@@ -76,27 +76,42 @@ _SEGMENT_REDUCE = {
 }
 
 
+def key_spans(nullables: Sequence[bool],
+              domains: Sequence[Tuple[int, int]]) -> List[int]:
+    """Per-key slot count: the value domain plus one NULL slot for
+    SCHEMA-nullable keys (SQL groups NULL keys together). Nullability
+    comes from the schema, not a batch's concrete validity — chunked
+    execution must keep ONE layout even when some chunks lack nulls."""
+    return [d + (1 if nullable else 0)
+            for nullable, (d, _lo) in zip(nullables, domains)]
+
+
 def direct_index(key_vecs: Sequence[Vec], domains: Sequence[Tuple[int, int]],
-                 sel):
+                 spans: Sequence[int], sel):
     """Combined dense-domain index per row; unselected rows get an
-    out-of-bounds index (scatter mode='drop' discards them).
+    out-of-bounds index (scatter mode='drop' discards them); NULL key
+    values map to the key's dedicated null slot.
     `domains` entries are (domain, lo) pairs from `key_domain`."""
     total = 1
     strides = []
-    for d, _lo in domains:
+    for span in spans:
         strides.append(total)
-        total *= d
+        total *= span
     idx = jnp.zeros((), jnp.int32)
-    for vec, (d, lo), s in zip(key_vecs, domains, strides):
-        idx = idx + _key_index(vec, d, lo) * s
+    for vec, (d, lo), span, s in zip(key_vecs, domains, spans, strides):
+        ki = _key_index(vec, d, lo)
+        if vec.validity is not None and span > d:
+            ki = jnp.where(vec.validity, ki, jnp.int32(d))  # null slot
+        idx = idx + ki * s
     if sel is not None:
         idx = jnp.where(sel, idx, total)
     return idx, total, strides
 
 
-def direct_init(domains: Sequence[Tuple[int, int]], specs: List[List[AccSpec]]):
-    """Fresh accumulator tables: (occupied_cnt, [[acc,...],...])."""
-    total = int(np.prod([d for d, _lo in domains] or [1]))
+def direct_init(spans: Sequence[int], specs: List[List[AccSpec]]):
+    """Fresh accumulator tables: (occupied_cnt, [[acc,...],...]).
+    `spans` are the per-key slot counts incl. null slots (key_spans)."""
+    total = int(np.prod(list(spans) or [1]))
     cnt = jnp.zeros((total,), jnp.int64)
     accs = [[jnp.full((total,), spec.neutral) for spec in row]
             for row in specs]
@@ -175,34 +190,47 @@ def direct_update(tables, idx, total, contribs: List[List],
     return cnt, new_accs
 
 
-def direct_keys(domains: Sequence[Tuple[int, int]], strides: Sequence[int],
-                key_dtypes: Sequence[T.DataType]) -> List:
-    """Reconstruct key column values from the dense domain index."""
-    total = int(np.prod([d for d, _lo in domains] or [1]))
+def direct_keys(domains: Sequence[Tuple[int, int]],
+                spans: Sequence[int], strides: Sequence[int],
+                key_dtypes: Sequence[T.DataType]) -> Tuple[List, List]:
+    """Reconstruct key column (values, validities) from the dense domain
+    index. A key's null slot (index == domain) decodes to validity False;
+    keys without a null slot get validity None."""
+    total = int(np.prod(list(spans) or [1]))
     out_idx = jnp.arange(total, dtype=jnp.int32)
     key_arrays = []
+    key_valids = []
     rem = out_idx
-    for (d, lo), s, dt in zip(reversed(list(domains)), reversed(strides),
-                              reversed(list(key_dtypes))):
+    for (d, lo), span, s, dt in zip(reversed(list(domains)),
+                                    reversed(list(spans)),
+                                    reversed(strides),
+                                    reversed(list(key_dtypes))):
         k = rem // s
         rem = rem - k * s
+        if span > d:  # has a null slot
+            key_valids.append(k != d)
+            k = jnp.minimum(k, d - 1)
+        else:
+            key_valids.append(None)
         key_arrays.append((k + jnp.int32(lo)).astype(dt.np_dtype))
     key_arrays.reverse()
-    return key_arrays
+    key_valids.reverse()
+    return key_arrays, key_valids
 
 
 def direct_aggregate(key_vecs: Sequence[Vec],
                      domains: Sequence[Tuple[int, int]],
+                     spans: Sequence[int],
                      contribs: List[List], specs: List[List[AccSpec]],
-                     sel) -> Tuple[List, List, object]:
+                     sel) -> Tuple[List, List, List, object]:
     """One-shot dense-domain aggregation.
-    Returns (key_arrays, acc_arrays, occupied)."""
-    idx, total, strides = direct_index(key_vecs, domains, sel)
-    tables = direct_init(domains, specs)
+    Returns (key_arrays, key_valids, acc_arrays, occupied)."""
+    idx, total, strides = direct_index(key_vecs, domains, spans, sel)
+    tables = direct_init(spans, specs)
     cnt, accs = direct_update(tables, idx, total, contribs, specs)
-    key_arrays = direct_keys(domains, strides,
-                             [v.dtype for v in key_vecs])
-    return key_arrays, accs, cnt > 0
+    key_arrays, key_valids = direct_keys(domains, spans, strides,
+                                         [v.dtype for v in key_vecs])
+    return key_arrays, key_valids, accs, cnt > 0
 
 
 def sort_aggregate(key_vecs: Sequence[Vec],

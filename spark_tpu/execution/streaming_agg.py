@@ -32,23 +32,107 @@ from . import aggregate as agg_kernels
 CHUNK_ROWS_KEY = "spark_tpu.sql.execution.streamingChunkRows"
 
 
-def find_streamable_chain(agg: "P.HashAggregateExec"
+#: join types where per-probe-chunk execution is sound: each probe row's
+#: output is independent of other probe rows (right/full append
+#: build-side rows once globally, so chunking the probe would emit them
+#: per chunk)
+_CHUNKABLE_JOINS = ("inner", "left", "left_semi", "left_anti")
+
+
+def find_streamable_chain(agg: "P.HashAggregateExec",
+                          allow_joins: bool = True
                           ) -> Optional[Tuple[List, P.LeafExec]]:
-    """agg.child must be a chain of Project/Filter over a single leaf."""
+    """agg.child must be a chain of Project/Filter — and, when
+    `allow_joins`, probe-side-chunkable joins (the build side is an
+    independent subtree, materialized once) — over a single leaf."""
     chain = []
     node = agg.child
-    while isinstance(node, (P.ProjectExec, P.FilterExec)):
-        chain.append(node)
-        node = node.children[0]
+    while True:
+        if isinstance(node, (P.ProjectExec, P.FilterExec)):
+            chain.append(node)
+            node = node.children[0]
+        elif allow_joins and isinstance(node, P.JoinExec) \
+                and node.how in _CHUNKABLE_JOINS:
+            chain.append(node)
+            node = node.children[0]  # continue down the probe side
+        else:
+            break
     if isinstance(node, (P.RangeExec, P.ScanExec)):
         return chain, node
     return None
 
 
-def _replay_chain(chain: List, ctx, batch: Batch) -> Batch:
+def _replay_chain(chain: List, ctx, batch: Batch,
+                  builds: Optional[dict] = None) -> Batch:
     for op in reversed(chain):
-        batch = op.compute(ctx, [batch])
+        if isinstance(op, P.JoinExec):
+            batch = op.compute(ctx, [batch, builds[op.tag]])
+        else:
+            batch = op.compute(ctx, [batch])
     return batch
+
+
+def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
+    """Compile + run an independent subtree (a join's build side) with
+    its own AQE capacity-retry loop — a stage materialization, like the
+    reference's QueryStageExec."""
+    scans: List[P.LeafExec] = []
+
+    def collect(n):
+        if getattr(n, "needs_input", False):
+            scans.append(n)
+        for c in n.children:
+            collect(c)
+
+    collect(root)
+    inputs = [s.load() for s in scans]
+    # the executor's capacity setters, so every overflow family the main
+    # AQE loop knows (join/exchange/aggregate) retries here too
+    from .executor import QueryExecution
+    adaptive = bool(conf.get("spark_tpu.sql.adaptive.enabled"))
+
+    for _attempt in range(8):
+        def run(ins):
+            ctx = P.ExecContext(conf)
+            counter = [0]
+
+            def replay(n):
+                if getattr(n, "needs_input", False):
+                    b = ins[counter[0]]
+                    counter[0] += 1
+                    return b
+                return n.compute(ctx, [replay(c) for c in n.children])
+
+            out = replay(root)
+            return out, ctx.flags, ctx.metrics
+
+        batch, flags, metrics = jax.jit(run)(inputs)
+        overflow = [k for k, v in flags.items()
+                    if k.startswith(("join_overflow_", "exch_overflow_",
+                                     "agg_overflow_"))
+                    and bool(np.asarray(v))]
+        if not overflow:
+            return batch
+        if not adaptive:
+            raise RuntimeError(
+                f"build-side capacity overflow in {overflow} with "
+                f"adaptive re-planning disabled")
+        for k in overflow:
+            if k.startswith("join_overflow_"):
+                tag = k[len("join_overflow_"):]
+                total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                QueryExecution._set_join_cap(
+                    root, tag, bucket_capacity(max(total, 8)))
+            elif k.startswith("exch_overflow_"):
+                tag = k[len("exch_overflow_"):]
+                mx = int(np.asarray(metrics[f"exch_max_{tag}"]))
+                QueryExecution._set_exchange_cap(
+                    root, tag, bucket_capacity(max(mx, 8)))
+            else:
+                tag = k[len("agg_overflow_"):]
+                total = int(np.asarray(metrics[f"agg_groups_{tag}"]))
+                QueryExecution._set_agg_groups(root, tag, max(total, 8))
+    raise RuntimeError("build-side capacity did not converge")
 
 
 def _range_chunk(leaf: P.RangeExec, start, chunk_rows: int,
@@ -117,32 +201,89 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
     first = next(iter(chunks), None)
     if first is None:
         return None
-    key = f"stream_scan:{agg.describe()}:{chunk_rows}"
-    bundle = cache.get(key) if cache is not None else None
-    if bundle is None:
-        ctx = P.ExecContext(conf)
-        probe = _replay_chain(chain, ctx, first)
-        prep = agg.prepare_direct(probe, conf)
-        if prep is None:
-            return None
 
-        def update(tables, b):
+    joins = [op for op in chain if isinstance(op, P.JoinExec)]
+    # build sides materialize ONCE (independent subtrees — the
+    # QueryStageExec role); per-chunk probes join against them in HBM
+    builds = {j.tag: _materialize_subtree(j.children[1], conf)
+              for j in joins}
+    saved_caps = {j.tag: j.out_cap for j in joins}
+    for j in joins:
+        if j.out_cap is None:
+            # per-chunk output capacity defaults to the CHUNK capacity,
+            # not the whole-scan capacity
+            j.out_cap = first.capacity
+
+    def make_update():
+        key = f"stream_scan:{agg.describe()}:{chunk_rows}"
+        bundle = cache.get(key) if cache is not None else None
+        if bundle is None:
             ctx = P.ExecContext(conf)
-            b = _replay_chain(chain, ctx, b)
-            return agg.direct_update_tables(tables, b, prep)
+            probe = _replay_chain(chain, ctx, first, builds)
+            prep0 = agg.prepare_direct(probe, conf)
+            if prep0 is None:
+                return None
 
-        bundle = (prep, jax.jit(update, donate_argnums=(0,)))
-        if cache is not None:
-            cache[key] = bundle
-    prep, update_donated = bundle
+            if joins:
+                def update(tables, b, bb):
+                    ctx = P.ExecContext(conf)
+                    b = _replay_chain(chain, ctx, b, bb)
+                    new = agg.direct_update_tables(tables, b, prep0)
+                    return new, ctx.flags, ctx.metrics
+
+                # no donation: a join-capacity overflow must re-run the
+                # SAME chunk against the pre-update tables
+                bundle = (prep0, jax.jit(update))
+            else:
+                def update(tables, b):
+                    ctx = P.ExecContext(conf)
+                    b = _replay_chain(chain, ctx, b)
+                    return agg.direct_update_tables(tables, b, prep0)
+
+                # join-free hot path: donate tables, no per-chunk host
+                # sync — the double-buffered host->HBM overlap
+                bundle = (prep0, jax.jit(update, donate_argnums=(0,)))
+            if cache is not None:
+                cache[key] = bundle
+        return bundle
+
+    bundle = make_update()
+    if bundle is None:
+        for j in joins:  # leave the whole-input fallback's caps alone
+            j.out_cap = saved_caps[j.tag]
+        return None
+    prep, update_fn = bundle
 
     check_dicts = _dict_growth_guard(agg, prep)
     tables = agg.direct_init_tables(prep)
+
+    def run_chunk(tables, b):
+        nonlocal update_fn
+        if not joins:
+            return update_fn(tables, b)
+        for _attempt in range(8):
+            new, flags, metrics = update_fn(tables, b, builds)
+            overflow = [k for k, v in flags.items()
+                        if k.startswith("join_overflow_")
+                        and bool(np.asarray(v))]
+            if not overflow:
+                return new
+            for k in overflow:
+                tag = k[len("join_overflow_"):]
+                total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                for j in joins:
+                    if j.tag == tag:
+                        j.out_cap = bucket_capacity(max(total, 8))
+            # out_cap is part of describe(): re-jit under the new key,
+            # then retry the SAME chunk against the pre-update tables
+            _prep2, update_fn = make_update()
+        raise RuntimeError("streamed join capacity did not converge")
+
     check_dicts(first)
-    tables = update_donated(tables, first)
+    tables = run_chunk(tables, first)
     for b in chunks:
         check_dicts(b)
-        tables = update_donated(tables, b)
+        tables = run_chunk(tables, b)
 
     dict_overrides = dict(chunks.dictionaries) if hasattr(
         chunks, "dictionaries") else {}
@@ -208,7 +349,9 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
 
     if agg.mode != "partial":
         return None
-    found = find_streamable_chain(agg)
+    # mesh streaming is unary-only: a streamed join would need the build
+    # replicated per shard — future work
+    found = find_streamable_chain(agg, allow_joins=False)
     if found is None:
         return None
     chain, leaf = found
@@ -301,6 +444,8 @@ def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
     chain, leaf = found
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
     if isinstance(leaf, P.RangeExec):
+        if any(isinstance(op, P.JoinExec) for op in chain):
+            return None  # joined Range: whole-input execution
         if leaf.num_rows() <= chunk_rows:
             return None
         return stream_range_aggregate(agg, chain, leaf, conf, cache)

@@ -458,16 +458,17 @@ class HashAggregateExec(UnaryExec):
         domains = [agg_kernels.key_domain(g, v)
                    for g, v in zip(self.group_exprs, key_vecs)]
         max_domain = int(ctx.conf.get("spark_tpu.sql.aggregate.maxDirectDomain"))
-        use_direct = (all(d is not None for d in domains)
-                      and all(v.validity is None for v in key_vecs)
-                      and int(np.prod([d for d, _lo in domains] or [1]))
-                      <= max_domain)
-
         cs = self.child.schema()
+        nullables = [g.nullable(cs) for g in self.group_exprs]
+        spans = agg_kernels.key_spans(
+            nullables, [d for d in domains if d is not None])
+        use_direct = (all(d is not None for d in domains)
+                      and int(np.prod(list(spans) or [1])) <= max_domain)
+
         if use_direct:
-            key_arrays, accs, occupied = agg_kernels.direct_aggregate(
-                key_vecs, domains, contribs, specs, sel)
-            key_valids = [None] * len(key_arrays)
+            key_arrays, key_valids, accs, occupied = \
+                agg_kernels.direct_aggregate(
+                    key_vecs, domains, spans, contribs, specs, sel)
         else:
             num_segments = batch.capacity
             if self.est_groups and self.group_exprs:
@@ -516,39 +517,43 @@ class HashAggregateExec(UnaryExec):
         """Trace-time check + static metadata for the dense-domain path.
         Returns None when any key lacks a static domain (sort path)."""
         base = self._base_schema()
+        cs = self.child.schema()
         key_vecs = [g.eval(probe_batch) for g in self.group_exprs]
         domains = []
         for g, v in zip(self.group_exprs, key_vecs):
             dom = agg_kernels.key_domain(g, v)
-            if dom is None or v.validity is not None:
+            if dom is None:
                 return None
             d, lo = dom
             if pad_dict and v.dictionary is not None:
                 # headroom for dictionaries that grow across chunks
                 d = bucket_capacity(max(16, 2 * d))
             domains.append((d, lo))
-        total = int(np.prod([d for d, _lo in domains] or [1]))
+        spans = agg_kernels.key_spans(
+            [g.nullable(cs) for g in self.group_exprs], domains)
+        total = int(np.prod(list(spans) or [1]))
         if total > int(conf.get("spark_tpu.sql.aggregate.maxDirectDomain")):
             return None
         strides = []
         t = 1
-        for d, _lo in domains:
+        for span in spans:
             strides.append(t)
-            t *= d
+            t *= span
         specs = [a.func.accumulators(base) for a in self.agg_exprs]
         return DirectAggPlan(
-            domains=domains, strides=strides, total=total,
+            domains=domains, spans=spans, strides=strides, total=total,
             key_dtypes=[v.dtype for v in key_vecs],
             key_dicts=[v.dictionary for v in key_vecs], specs=specs)
 
     def direct_init_tables(self, prep: "DirectAggPlan"):
-        return agg_kernels.direct_init(prep.domains, prep.specs)
+        return agg_kernels.direct_init(prep.spans, prep.specs)
 
     def direct_update_tables(self, tables, batch: Batch,
                              prep: "DirectAggPlan", conf=None):
         sel = batch.selection
         key_vecs = [g.eval(batch) for g in self.group_exprs]
-        idx, _, _ = agg_kernels.direct_index(key_vecs, prep.domains, sel)
+        idx, _, _ = agg_kernels.direct_index(key_vecs, prep.domains,
+                                             prep.spans, sel)
         contribs = [a.func.update(batch, sel) for a in self.agg_exprs]
         return agg_kernels.direct_update(tables, idx, prep.total, contribs,
                                          prep.specs)
@@ -558,16 +563,17 @@ class HashAggregateExec(UnaryExec):
         cnt, accs = tables
         base = self._base_schema()
         occupied = cnt > 0
-        key_arrays = agg_kernels.direct_keys(prep.domains, prep.strides,
-                                             prep.key_dtypes)
+        key_arrays, key_valids = agg_kernels.direct_keys(
+            prep.domains, prep.spans, prep.strides, prep.key_dtypes)
         if not self.group_exprs:
             occupied = jnp.ones((1,), jnp.bool_)
         cols: Dict[str, Column] = {}
-        for g, arr, dt, dic in zip(self.group_exprs, key_arrays,
-                                   prep.key_dtypes, prep.key_dicts):
+        for g, arr, kv, dt, dic in zip(self.group_exprs, key_arrays,
+                                       key_valids, prep.key_dtypes,
+                                       prep.key_dicts):
             if dict_overrides and g.name() in dict_overrides:
                 dic = dict_overrides[g.name()]
-            cols[g.name()] = Column(arr, dt, None, dic)
+            cols[g.name()] = Column(arr, dt, kv, dic)
         for i, a in enumerate(self.agg_exprs):
             data, validity = a.func.device_finalize(accs[i], base)
             cols[a.out_name] = Column(data, a.func.result_type(base), validity)
@@ -580,14 +586,15 @@ class HashAggregateExec(UnaryExec):
         shape the exchange+final stages consume)."""
         cnt, accs = tables
         base = self._base_schema()
-        key_arrays = agg_kernels.direct_keys(prep.domains, prep.strides,
-                                             prep.key_dtypes)
+        key_arrays, key_valids = agg_kernels.direct_keys(
+            prep.domains, prep.spans, prep.strides, prep.key_dtypes)
         cols: Dict[str, Column] = {}
-        for g, arr, dt, dic in zip(self.group_exprs, key_arrays,
-                                   prep.key_dtypes, prep.key_dicts):
+        for g, arr, kv, dt, dic in zip(self.group_exprs, key_arrays,
+                                       key_valids, prep.key_dtypes,
+                                       prep.key_dicts):
             if dict_overrides and g.name() in dict_overrides:
                 dic = dict_overrides[g.name()]
-            cols[g.name()] = Column(arr, dt, None, dic)
+            cols[g.name()] = Column(arr, dt, kv, dic)
         for i, a in enumerate(self.agg_exprs):
             for j, spec in enumerate(prep.specs[i]):
                 cols[self._acc_col_name(i, j, spec)] = Column(
@@ -626,6 +633,7 @@ class DirectAggPlan:
     `domains` entries are (domain, lo) pairs — see `aggregate.key_domain`."""
 
     domains: List[Tuple[int, int]]
+    spans: List[int]  # domain + null slot for schema-nullable keys
     strides: List[int]
     total: int
     key_dtypes: List[T.DataType]

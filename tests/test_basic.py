@@ -214,3 +214,105 @@ def test_pmod(session):
         pa.table({"x": pa.array(vals, type=pa.int64())}))
     out = df.select(pmod(col("x"), lit(3)).alias("r")).collect()
     assert out.column("r").to_pylist() == [v % 3 for v in vals]
+
+
+def test_streamed_join_aggregate(session):
+    """Chunked scans stream THROUGH joins: build sides materialize once,
+    probe chunks join + fold into carried tables (the over-HBM path for
+    join+aggregate queries; SURVEY section 7 step 8)."""
+    import numpy as np
+    import pandas as pd
+    import spark_tpu.execution.streaming_agg as SA
+    from spark_tpu import functions as F
+    from spark_tpu.functions import col
+
+    rs = np.random.RandomState(12)
+    fact = pd.DataFrame({
+        "fk": rs.randint(0, 50, 6000).astype(np.int64),
+        "v": rs.randint(0, 1000, 6000).astype(np.int64)})
+    dim = pd.DataFrame({"fk": np.arange(50, dtype=np.int64),
+                        "g": (np.arange(50, dtype=np.int64) % 7)})
+    session.register_table("sj_fact", fact)
+    session.register_table("sj_dim", dim)
+
+    engaged = []
+    orig = SA.stream_scan_aggregate
+
+    def spy(agg, chain, leaf, conf, cache=None):
+        out = orig(agg, chain, leaf, conf, cache)
+        engaged.append((out is not None,
+                        sum(1 for op in chain
+                            if hasattr(op, "left_keys"))))
+        return out
+
+    SA.stream_scan_aggregate = spy
+    prev = session.conf.get("spark_tpu.sql.execution.streamingChunkRows")
+    session.conf.set("spark_tpu.sql.execution.streamingChunkRows", 1024)
+    try:
+        got = (session.table("sj_fact")
+               .join(session.table("sj_dim"), on="fk")
+               .group_by(F.pmod(col("g"), 7).alias("gg"))
+               .agg(F.sum(col("v")).alias("s"), F.count().alias("c"))
+               .to_pandas().sort_values("gg").reset_index(drop=True))
+    finally:
+        SA.stream_scan_aggregate = orig
+        session.conf.set("spark_tpu.sql.execution.streamingChunkRows", prev)
+
+    m = fact.merge(dim, on="fk")
+    want = (m.assign(gg=m["g"] % 7).groupby("gg")
+            .agg(s=("v", "sum"), c=("v", "size")).reset_index())
+    assert got["s"].tolist() == want["s"].tolist()
+    assert got["c"].tolist() == want["c"].tolist()
+    assert any(ok and njoins > 0 for ok, njoins in engaged), engaged
+
+
+def test_streamed_join_many_to_many_overflow(session):
+    """Per-chunk join expansion overflowing the chunk capacity must
+    retry with a bigger capacity, not drop pairs."""
+    import numpy as np
+    import pandas as pd
+    from spark_tpu import functions as F
+    from spark_tpu.functions import col
+
+    fact = pd.DataFrame({"fk": np.zeros(3000, dtype=np.int64),
+                         "v": np.ones(3000, dtype=np.int64)})
+    dim = pd.DataFrame({"fk": np.zeros(4, dtype=np.int64),
+                        "g": np.arange(4, dtype=np.int64)})
+    session.register_table("sjo_fact", fact)
+    session.register_table("sjo_dim", dim)
+    prev = session.conf.get("spark_tpu.sql.execution.streamingChunkRows")
+    session.conf.set("spark_tpu.sql.execution.streamingChunkRows", 512)
+    try:
+        got = (session.table("sjo_fact")
+               .join(session.table("sjo_dim"), on="fk")
+               .group_by(F.pmod(col("g"), 4).alias("gg"))
+               .agg(F.count().alias("c"))
+               .to_pandas().sort_values("gg").reset_index(drop=True))
+    finally:
+        session.conf.set("spark_tpu.sql.execution.streamingChunkRows", prev)
+    # every fact row matches all 4 dim rows: 3000 per group
+    assert got["c"].tolist() == [3000] * 4
+
+
+def test_groupby_null_keys_direct_path(session):
+    """NULL group keys form their own group on the dense-domain path
+    (the dedicated null slot; SQL null-grouping semantics)."""
+    import numpy as np
+    import pandas as pd
+    from spark_tpu import functions as F
+    from spark_tpu.functions import col
+
+    pdf = pd.DataFrame({"k": pd.array([1, 2, None, 1, None, 2, 1],
+                                      dtype="Int8"),
+                        "v": np.arange(7, dtype=np.int64)})
+    session.register_table("nullkeys", pdf)
+    got = (session.table("nullkeys").group_by(col("k"))
+           .agg(F.sum(col("v")).alias("s"), F.count().alias("c"))
+           .to_pandas())
+    got = got.sort_values("k", na_position="last").reset_index(drop=True)
+    want = (pdf.groupby("k", dropna=False)["v"]
+            .agg(["sum", "size"]).reset_index()
+            .sort_values("k", na_position="last").reset_index(drop=True))
+    assert got["s"].tolist() == want["sum"].tolist()
+    assert got["c"].tolist() == want["size"].tolist()
+    assert got["k"].isna().sum() == 1
