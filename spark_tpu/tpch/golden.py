@@ -261,3 +261,31 @@ def q10(path: str) -> pd.DataFrame:
 
 GOLDEN_RAW_Q10 = q10
 GOLDEN["q10"] = _cached("q10", q10)
+
+
+def q9(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    p = _read(path, "part")
+    s = _read(path, "supplier")
+    ps = _read(path, "partsupp")
+    o = _read(path, "orders")
+    n = _read(path, "nation")
+    p = p[p["p_name"].str.contains("name 5", regex=False)]
+    m = (l.merge(p, left_on="l_partkey", right_on="p_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(ps, left_on=["l_suppkey", "l_partkey"],
+                right_on=["ps_suppkey", "ps_partkey"])
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    amount = (m["l_extendedprice"] * (1 - m["l_discount"])
+              - m["ps_supplycost"] * m["l_quantity"])
+    year = pd.to_datetime(m["o_orderdate"]).dt.year
+    g = pd.DataFrame({"nation": m["n_name"], "o_year": year,
+                      "amount": amount})
+    out = (g.groupby(["nation", "o_year"], as_index=False)
+           .agg(sum_profit=("amount", "sum"))
+           .sort_values(["nation", "o_year"], ascending=[True, False]))
+    return out.reset_index(drop=True)
+
+
+GOLDEN["q9"] = _cached("q9", q9)

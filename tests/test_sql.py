@@ -348,3 +348,10 @@ def test_tpch_q10(sql_session):
     want = G.GOLDEN["q10"](sql_session._tpch_path)
     got = got[want.columns.tolist()]
     G.compare(got.reset_index(drop=True), want)
+
+
+def test_tpch_q9(sql_session):
+    got = _norm(sql_session.sql(SQL_QUERIES["q9"]).to_pandas())
+    want = G.GOLDEN["q9"](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)
