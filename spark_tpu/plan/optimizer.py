@@ -159,8 +159,28 @@ class PruneColumns(Rule):
                 ln = rn = None
             else:
                 want = needed | refs
+                # references use POST-join names: collisions were `_r`
+                # -suffixed (Join.right_name_map), so a wanted `x_r` must
+                # keep the right child's `x`
+                rename = {out: orig for orig, out
+                          in node.right_name_map().items()}
                 ln = {n for n in want if n in left_names}
-                rn = {n for n in want if n in right_names}
+                rn = set()
+                for w in want:
+                    orig = rename.get(w, w)
+                    if orig not in right_names:
+                        continue
+                    rn.add(orig)
+                    # a rename exists only while its colliding columns
+                    # do: pruning them would silently change the join's
+                    # output names. Keep the WHOLE `_r` chain alive —
+                    # for a wanted `x_r_r`, both left `x` and `x_r`
+                    # forced the suffixes.
+                    step = orig
+                    while step != w:
+                        if step in left_names:
+                            ln.add(step)
+                        step = step + "_r"
             new = copy_join(node, self._prune(node.left, ln),
                             self._prune(node.right, rn))
             return new

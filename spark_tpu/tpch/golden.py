@@ -289,3 +289,72 @@ def q9(path: str) -> pd.DataFrame:
 
 
 GOLDEN["q9"] = _cached("q9", q9)
+
+
+def q7(path: str) -> pd.DataFrame:
+    s = _read(path, "supplier")
+    l = _read(path, "lineitem")
+    o = _read(path, "orders")
+    c = _read(path, "customer")
+    n = _read(path, "nation")
+    l = l[(l["l_shipdate"] >= pd.Timestamp("1995-01-01").date())
+          & (l["l_shipdate"] <= pd.Timestamp("1996-12-31").date())]
+    m = (l.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(n.rename(columns=lambda x: x + "_1"),
+                left_on="s_nationkey", right_on="n_nationkey_1")
+         .merge(n.rename(columns=lambda x: x + "_2"),
+                left_on="c_nationkey", right_on="n_nationkey_2"))
+    cond = (((m["n_name_1"] == "FRANCE") & (m["n_name_2"] == "GERMANY"))
+            | ((m["n_name_1"] == "GERMANY") & (m["n_name_2"] == "FRANCE")))
+    m = m[cond]
+    vol = m["l_extendedprice"] * (1 - m["l_discount"])
+    year = pd.to_datetime(m["l_shipdate"]).dt.year
+    g = pd.DataFrame({"supp_nation": m["n_name_1"],
+                      "cust_nation": m["n_name_2"],
+                      "l_year": year, "revenue": vol})
+    out = (g.groupby(["supp_nation", "cust_nation", "l_year"],
+                     as_index=False).agg(revenue=("revenue", "sum"))
+           .sort_values(["supp_nation", "cust_nation", "l_year"]))
+    return out.reset_index(drop=True)
+
+
+GOLDEN["q7"] = _cached("q7", q7)
+
+
+def q8(path: str) -> pd.DataFrame:
+    p = _read(path, "part")
+    s = _read(path, "supplier")
+    l = _read(path, "lineitem")
+    o = _read(path, "orders")
+    c = _read(path, "customer")
+    n = _read(path, "nation")
+    r = _read(path, "region")
+    p = p[p["p_type"] == "TYPE 25"]
+    o = o[(o["o_orderdate"] >= pd.Timestamp("1995-01-01").date())
+          & (o["o_orderdate"] <= pd.Timestamp("1996-12-31").date())]
+    m = (l.merge(p, left_on="l_partkey", right_on="p_partkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(n.rename(columns=lambda x: x + "_1"),
+                left_on="c_nationkey", right_on="n_nationkey_1")
+         .merge(r, left_on="n_regionkey_1", right_on="r_regionkey")
+         .merge(n.rename(columns=lambda x: x + "_2"),
+                left_on="s_nationkey", right_on="n_nationkey_2"))
+    m = m[m["r_name"] == "AMERICA"]
+    vol = m["l_extendedprice"] * (1 - m["l_discount"])
+    year = pd.to_datetime(m["o_orderdate"]).dt.year
+    g = pd.DataFrame({"o_year": year, "volume": vol,
+                      "nation": m["n_name_2"]})
+    def share(sub):
+        tot = sub["volume"].sum()
+        br = sub.loc[sub["nation"] == "BRAZIL", "volume"].sum()
+        return br / tot if tot else np.nan
+    out = (g.groupby("o_year").apply(share, include_groups=False)
+           .reset_index(name="mkt_share").sort_values("o_year"))
+    return out.reset_index(drop=True)
+
+
+GOLDEN["q8"] = _cached("q8", q8)

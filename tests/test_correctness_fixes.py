@@ -140,3 +140,30 @@ def test_groupby_negative_bytes(session):
            .to_pandas().sort_values("b").reset_index(drop=True))
     assert list(out["b"]) == [-128, -1, 0, 127]
     assert list(out["s"]) == [1, 7, 3, 4]
+
+
+def test_prune_columns_preserves_join_renames(session):
+    """Plan-level: pruning must not change join output names — the
+    colliding left column that forced an `_r` suffix stays alive
+    (code-review: chained `x`/`x_r` collisions included)."""
+    import pandas as pd
+    from spark_tpu.functions import col
+    from spark_tpu.plan.logical import Join, Project, Scan
+    from spark_tpu.plan.optimizer import PruneColumns
+
+    left = pd.DataFrame({"k": [1, 2], "x": [10, 20], "x_r": [5, 6]})
+    right = pd.DataFrame({"k": [1, 2], "x": [7, 8]})
+    df = (session.create_dataframe(left, "pl")
+          .join(session.create_dataframe(right, "pr"),
+                left_on=col("k"), right_on=col("k")))
+    # right `x` collides twice -> x_r_r
+    assert "x_r_r" in df.plan.schema().names
+    pruned = PruneColumns().apply(
+        Project(df.plan, [col("x_r_r")]))
+    # output name still resolves after pruning
+    assert pruned.schema().names == ["x_r_r"]
+    got = (session.create_dataframe(left, "pl2")
+           .join(session.create_dataframe(right, "pr2"),
+                 left_on=col("k"), right_on=col("k"))
+           .select(col("x_r_r")).to_pandas())
+    assert got["x_r_r"].tolist() == [7, 8]

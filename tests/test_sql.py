@@ -355,3 +355,17 @@ def test_tpch_q9(sql_session):
     want = G.GOLDEN["q9"](sql_session._tpch_path)
     got = got[want.columns.tolist()]
     G.compare(got.reset_index(drop=True), want)
+
+
+def test_tpch_q7(sql_session):
+    got = _norm(sql_session.sql(SQL_QUERIES["q7"]).to_pandas())
+    want = G.GOLDEN["q7"](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)
+
+
+def test_tpch_q8(sql_session):
+    got = _norm(sql_session.sql(SQL_QUERIES["q8"]).to_pandas())
+    want = G.GOLDEN["q8"](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)
