@@ -358,3 +358,58 @@ def q8(path: str) -> pd.DataFrame:
 
 
 GOLDEN["q8"] = _cached("q8", q8)
+
+
+def q13(path: str) -> pd.DataFrame:
+    c = _read(path, "customer")
+    o = _read(path, "orders")
+    o = o[~o["o_comment"].str.contains("comment 7", regex=False)]
+    m = c.merge(o, left_on="c_custkey", right_on="o_custkey", how="left")
+    per_cust = (m.groupby("c_custkey")["o_orderkey"].count()
+                .reset_index(name="c_count"))
+    out = (per_cust.groupby("c_count").size().reset_index(name="custdist")
+           .sort_values(["custdist", "c_count"], ascending=[False, False]))
+    return out[["c_count", "custdist"]].reset_index(drop=True)
+
+
+def q18(path: str) -> pd.DataFrame:
+    c = _read(path, "customer")
+    o = _read(path, "orders")
+    l = _read(path, "lineitem")
+    big = l.groupby("l_orderkey")["l_quantity"].sum()
+    keys = big[big > 300].index
+    m = (o[o["o_orderkey"].isin(keys)]
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey"))
+    out = (m.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                      "o_totalprice"], as_index=False)
+           .agg(sum_qty=("l_quantity", "sum"))
+           .sort_values(["o_totalprice", "o_orderdate"],
+                        ascending=[False, True]).head(100))
+    return out.reset_index(drop=True)
+
+
+GOLDEN["q13"] = _cached("q13", q13)
+GOLDEN["q18"] = _cached("q18", q18)
+
+
+def q16(path: str) -> pd.DataFrame:
+    ps = _read(path, "partsupp")
+    p = _read(path, "part")
+    s = _read(path, "supplier")
+    p = p[(p["p_brand"] != "Brand#45")
+          & ~p["p_type"].str.startswith("TYPE 3")
+          & p["p_size"].isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    bad = s[s["s_comment"].str.contains("comment 5", regex=False)][
+        "s_suppkey"]
+    m = ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+    m = m[~m["ps_suppkey"].isin(bad)]
+    out = (m.groupby(["p_brand", "p_type", "p_size"])["ps_suppkey"]
+           .nunique().reset_index(name="supplier_cnt")
+           .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                        ascending=[False, True, True, True]))
+    return out[["p_brand", "p_type", "p_size", "supplier_cnt"]] \
+        .reset_index(drop=True)
+
+
+GOLDEN["q16"] = _cached("q16", q16)

@@ -352,3 +352,91 @@ order by
 """
 
 SQL_QUERIES["q8"] = Q8
+
+Q13 = """
+select
+    c_count,
+    count(*) as custdist
+from
+    (
+        select
+            c_custkey,
+            count(o_orderkey) as c_count
+        from
+            customer left outer join orders on
+                c_custkey = o_custkey
+                and o_comment not like '%comment 7%'
+        group by
+            c_custkey
+    ) c_orders
+group by
+    c_count
+order by
+    custdist desc,
+    c_count desc
+"""
+
+Q18 = """
+select
+    c_name,
+    c_custkey,
+    o_orderkey,
+    o_orderdate,
+    o_totalprice,
+    sum(l_quantity) as sum_qty
+from
+    customer,
+    orders,
+    lineitem
+where
+    o_orderkey in (
+        select l_orderkey from lineitem
+        group by l_orderkey
+        having sum(l_quantity) > 300
+    )
+    and c_custkey = o_custkey
+    and o_orderkey = l_orderkey
+group by
+    c_name,
+    c_custkey,
+    o_orderkey,
+    o_orderdate,
+    o_totalprice
+order by
+    o_totalprice desc,
+    o_orderdate
+limit 100
+"""
+
+SQL_QUERIES.update({"q13": Q13, "q18": Q18})
+
+Q16 = """
+select
+    p_brand,
+    p_type,
+    p_size,
+    count(distinct ps_suppkey) as supplier_cnt
+from
+    partsupp,
+    part
+where
+    p_partkey = ps_partkey
+    and p_brand <> 'Brand#45'
+    and p_type not like 'TYPE 3%'
+    and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+    and ps_suppkey not in (
+        select s_suppkey from supplier
+        where s_comment like '%comment 5%'
+    )
+group by
+    p_brand,
+    p_type,
+    p_size
+order by
+    supplier_cnt desc,
+    p_brand,
+    p_type,
+    p_size
+"""
+
+SQL_QUERIES["q16"] = Q16

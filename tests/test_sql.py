@@ -369,3 +369,18 @@ def test_tpch_q8(sql_session):
     want = G.GOLDEN["q8"](sql_session._tpch_path)
     got = got[want.columns.tolist()]
     G.compare(got.reset_index(drop=True), want)
+
+
+@pytest.mark.parametrize("qname", ["q13", "q18"])
+def test_tpch_q13_q18(sql_session, qname):
+    got = _norm(sql_session.sql(SQL_QUERIES[qname]).to_pandas())
+    want = G.GOLDEN[qname](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)
+
+
+def test_tpch_q16(sql_session):
+    got = _norm(sql_session.sql(SQL_QUERIES["q16"]).to_pandas())
+    want = G.GOLDEN["q16"](sql_session._tpch_path)
+    got = got[want.columns.tolist()]
+    G.compare(got.reset_index(drop=True), want)
