@@ -1181,6 +1181,23 @@ class Lowerer:
             star_items = [(ColumnRef(n), None) for n in child_names]
             items = star_items + items
 
+        # uncorrelated scalar subqueries are legal anywhere an expression
+        # is (SELECT items, HAVING thresholds — TPC-H Q11): lower them to
+        # executor-resolved ScalarSubqueryExpr nodes up front. Correlated
+        # ones outside WHERE stay unsupported (raise at analysis).
+        def scalarize(e: Expression) -> Expression:
+            if isinstance(e, _ScalarSubquery):
+                if self._subquery_is_correlated(e.select):
+                    raise AnalysisError(
+                        "correlated scalar subqueries are only supported "
+                        "in WHERE conjuncts (not SELECT/HAVING)")
+                return L.ScalarSubqueryExpr(self.lower(e.select))
+            return e.map_children(scalarize)
+
+        items = [(scalarize(e), a) for e, a in items]
+        if sel.having is not None:
+            sel.having = scalarize(sel.having)
+
         has_agg = any(_contains_agg(e) for e, _ in items) or \
             sel.group_by is not None or \
             (sel.having is not None and _contains_agg(sel.having))
@@ -1434,25 +1451,25 @@ class Lowerer:
         # comparison (or expression) containing scalar subqueries
         return self._rewrite_scalar_in_conjunct(plan, c, scope)
 
+    def _subquery_is_correlated(self, sub: _Select) -> bool:
+        if not (sub.relations and len(sub.relations) == 1
+                and not sub.joins):
+            return False
+        inner_alias = _inner_alias_of(sub)
+        inner_names = set(
+            self._rel_plan(sub.relations[0][0]).schema().names)
+        return any(
+            _classify_side(cc, inner_alias, inner_names)
+            in ("outer", "mixed")
+            for cc in _conjuncts(sub.where))
+
     def _rewrite_scalar_in_conjunct(self, plan, c: Expression,
                                     scope: _Scope) -> L.LogicalPlan:
-        def has_correlation(sub: _Select) -> bool:
-            if not (sub.relations and len(sub.relations) == 1
-                    and not sub.joins):
-                return False
-            inner_alias = _inner_alias_of(sub)
-            inner_names = set(
-                self._rel_plan(sub.relations[0][0]).schema().names)
-            return any(
-                _classify_side(cc, inner_alias, inner_names)
-                in ("outer", "mixed")
-                for cc in _conjuncts(sub.where))
-
         def rewrite(e: Expression) -> Expression:
             nonlocal plan
             if isinstance(e, _ScalarSubquery):
                 sub = e.select
-                if not has_correlation(sub):
+                if not self._subquery_is_correlated(sub):
                     return L.ScalarSubqueryExpr(self.lower(sub))
                 # correlated scalar aggregate -> grouped aggregate joined
                 # on the correlation keys (RewriteCorrelatedScalarSubquery)

@@ -413,3 +413,40 @@ def q16(path: str) -> pd.DataFrame:
 
 
 GOLDEN["q16"] = _cached("q16", q16)
+
+
+def q11(path: str) -> pd.DataFrame:
+    ps = _read(path, "partsupp")
+    s = _read(path, "supplier")
+    n = _read(path, "nation")
+    m = (ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    m = m[m["n_name"] == "GERMANY"]
+    m = m.assign(value=m["ps_supplycost"] * m["ps_availqty"])
+    thresh = m["value"].sum() * 0.0001
+    out = (m.groupby("ps_partkey", as_index=False)
+           .agg(value=("value", "sum")))
+    out = out[out["value"] > thresh] \
+        .sort_values("value", ascending=False)
+    return out[["ps_partkey", "value"]].reset_index(drop=True)
+
+
+def q22(path: str) -> pd.DataFrame:
+    c = _read(path, "customer")
+    o = _read(path, "orders")
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    c = c.assign(cntrycode=c["c_phone"].str[:2])
+    cc = c[c["cntrycode"].isin(codes)]
+    avg_bal = cc.loc[cc["c_acctbal"] > 0.0, "c_acctbal"].mean()
+    sel = cc[(cc["c_acctbal"] > avg_bal)
+             & ~cc["c_custkey"].isin(o["o_custkey"])]
+    out = (sel.groupby("cntrycode", as_index=False)
+           .agg(numcust=("c_custkey", "size"),
+                totacctbal=("c_acctbal", "sum"))
+           .sort_values("cntrycode"))
+    return out[["cntrycode", "numcust", "totacctbal"]] \
+        .reset_index(drop=True)
+
+
+GOLDEN["q11"] = _cached("q11", q11)
+GOLDEN["q22"] = _cached("q22", q22)

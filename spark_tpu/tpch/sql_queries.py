@@ -440,3 +440,63 @@ order by
 """
 
 SQL_QUERIES["q16"] = Q16
+
+Q11 = """
+select
+    ps_partkey,
+    sum(ps_supplycost * ps_availqty) as value
+from
+    partsupp,
+    supplier,
+    nation
+where
+    ps_suppkey = s_suppkey
+    and s_nationkey = n_nationkey
+    and n_name = 'GERMANY'
+group by
+    ps_partkey
+having
+    sum(ps_supplycost * ps_availqty) > (
+        select sum(ps_supplycost * ps_availqty) * 0.0001
+        from partsupp, supplier, nation
+        where ps_suppkey = s_suppkey
+          and s_nationkey = n_nationkey
+          and n_name = 'GERMANY'
+    )
+order by
+    value desc
+"""
+
+Q22 = """
+select
+    cntrycode,
+    count(*) as numcust,
+    sum(c_acctbal) as totacctbal
+from
+    (
+        select
+            substring(c_phone, 1, 2) as cntrycode,
+            c_acctbal,
+            c_custkey
+        from
+            customer
+        where
+            substring(c_phone, 1, 2) in
+                ('13', '31', '23', '29', '30', '18', '17')
+            and c_acctbal > (
+                select avg(c_acctbal) from customer
+                where c_acctbal > 0.00
+                  and substring(c_phone, 1, 2) in
+                      ('13', '31', '23', '29', '30', '18', '17')
+            )
+            and not exists (
+                select * from orders where o_custkey = c_custkey
+            )
+    ) custsale
+group by
+    cntrycode
+order by
+    cntrycode
+"""
+
+SQL_QUERIES.update({"q11": Q11, "q22": Q22})

@@ -245,7 +245,9 @@ def test_decimal_float_compare_large_values(session):
     assert len(got) == 1
 
 
-@pytest.mark.parametrize("qname", ["q4", "q12", "q14", "q17", "q19"])
+@pytest.mark.parametrize("qname", ["q4", "q7", "q8", "q9", "q10", "q11",
+                                   "q12", "q13", "q14", "q16", "q17",
+                                   "q18", "q19", "q22"])
 def test_tpch_sql_extended(sql_session, qname):
     got = _norm(sql_session.sql(SQL_QUERIES[qname]).to_pandas())
     want = G.GOLDEN[qname](sql_session._tpch_path)
@@ -343,44 +345,21 @@ def test_exists_with_aggregate_raises(bounds):
         """).to_pandas()
 
 
-def test_tpch_q10(sql_session):
-    got = _norm(sql_session.sql(SQL_QUERIES["q10"]).to_pandas())
-    want = G.GOLDEN["q10"](sql_session._tpch_path)
-    got = got[want.columns.tolist()]
-    G.compare(got.reset_index(drop=True), want)
 
 
-def test_tpch_q9(sql_session):
-    got = _norm(sql_session.sql(SQL_QUERIES["q9"]).to_pandas())
-    want = G.GOLDEN["q9"](sql_session._tpch_path)
-    got = got[want.columns.tolist()]
-    G.compare(got.reset_index(drop=True), want)
 
 
-def test_tpch_q7(sql_session):
-    got = _norm(sql_session.sql(SQL_QUERIES["q7"]).to_pandas())
-    want = G.GOLDEN["q7"](sql_session._tpch_path)
-    got = got[want.columns.tolist()]
-    G.compare(got.reset_index(drop=True), want)
 
 
-def test_tpch_q8(sql_session):
-    got = _norm(sql_session.sql(SQL_QUERIES["q8"]).to_pandas())
-    want = G.GOLDEN["q8"](sql_session._tpch_path)
-    got = got[want.columns.tolist()]
-    G.compare(got.reset_index(drop=True), want)
 
 
-@pytest.mark.parametrize("qname", ["q13", "q18"])
-def test_tpch_q13_q18(sql_session, qname):
-    got = _norm(sql_session.sql(SQL_QUERIES[qname]).to_pandas())
-    want = G.GOLDEN[qname](sql_session._tpch_path)
-    got = got[want.columns.tolist()]
-    G.compare(got.reset_index(drop=True), want)
 
 
-def test_tpch_q16(sql_session):
-    got = _norm(sql_session.sql(SQL_QUERIES["q16"]).to_pandas())
-    want = G.GOLDEN["q16"](sql_session._tpch_path)
-    got = got[want.columns.tolist()]
-    G.compare(got.reset_index(drop=True), want)
+
+
+
+
+
+
+
+
