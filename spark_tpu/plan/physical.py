@@ -421,17 +421,22 @@ class HashAggregateExec(UnaryExec):
 
     def _base_schema(self) -> T.Schema:
         """Schema the aggregate functions' children resolve against: the
-        pre-aggregation input schema (threaded through partial->final —
-        or stashed on a spliced InputExec when a streamed partial
-        replaced the subtree)."""
-        node: PhysicalPlan = self
-        while isinstance(node, (HashAggregateExec, ExchangeExec)):
+        pre-aggregation input schema. A FINAL stage looks through its
+        exchange to its own partial stage (or a spliced InputExec's
+        stashed schema); complete/partial stages resolve against their
+        direct child — which may itself be an INDEPENDENT aggregate
+        (nested aggregation, e.g. max over a grouped subquery) whose
+        OUTPUT schema is exactly the right base."""
+        node: PhysicalPlan = self.children[0]
+        while isinstance(node, ExchangeExec):
+            node = node.children[0]
+        if self.mode == "final":
+            if isinstance(node, HashAggregateExec):
+                return node._base_schema()
             stashed = getattr(node, "_agg_base_schema", None)
             if stashed is not None:
                 return stashed
-            node = node.children[0]
-        stashed = getattr(node, "_agg_base_schema", None)
-        return stashed if stashed is not None else node.schema()
+        return node.schema()
 
     def compute(self, ctx, inputs):
         batch = inputs[0]

@@ -41,7 +41,7 @@ _KEYWORDS = {
     "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "JOIN", "INNER",
     "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "SEMI", "ANTI", "ON",
     "ASC", "DESC", "UNION", "ALL", "DISTINCT", "DATE", "INTERVAL",
-    "EXTRACT", "TRUE", "FALSE", "EXISTS",
+    "EXTRACT", "TRUE", "FALSE", "EXISTS", "WITH",
 }
 
 
@@ -134,6 +134,38 @@ class Parser:
     # -- statements ---------------------------------------------------------
 
     def parse_statement(self) -> "_Select":
+        ctes = []
+        if self.at_kw("WITH"):
+            self.next()
+            while True:
+                name = self._ident()
+                col_aliases = None
+                if self.at_op("("):
+                    self.next()
+                    col_aliases = [self._ident()]
+                    while self.eat_op(","):
+                        col_aliases.append(self._ident())
+                    self.expect_op(")")
+                self.expect_kw("AS")
+                self.expect_op("(")
+                body = self.parse_query_expr()
+                self.expect_op(")")
+                ctes.append((name, col_aliases, body))
+                if not self.eat_op(","):
+                    break
+        sel = self.parse_query_expr()
+        sel.ctes = ctes
+        self.eat_op(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise ParseError(f"unexpected trailing input at {t.pos}: "
+                             f"{t.value!r}")
+        return sel
+
+    def parse_query_expr(self) -> "_Select":
+        """select [UNION ALL select]... — the query-expression body used
+        at top level AND inside CTE bodies/subqueries, so set operations
+        work in every position."""
         sel = self.parse_select()
         while self.at_kw("UNION"):
             self.next()
@@ -148,11 +180,6 @@ class Parser:
             right.order_by = None
             right.limit = None
             sel = union
-        self.eat_op(";")
-        t = self.peek()
-        if t.kind != "eof":
-            raise ParseError(f"unexpected trailing input at {t.pos}: "
-                             f"{t.value!r}")
         return sel
 
     def parse_select(self) -> "_Select":
@@ -273,7 +300,7 @@ class Parser:
     def parse_table_ref(self):
         if self.at_op("("):
             self.next()
-            sub = self.parse_select()
+            sub = self.parse_query_expr()
             self.expect_op(")")
             self.eat_kw("AS")
             alias = self._ident()
@@ -331,7 +358,7 @@ class Parser:
         elif self.eat_kw("IN"):
             self.expect_op("(")
             if self.at_kw("SELECT"):
-                sub = self.parse_select()
+                sub = self.parse_query_expr()
                 self.expect_op(")")
                 e = _InSubquery(e, sub)
             else:
@@ -412,7 +439,7 @@ class Parser:
         t = self.peek()
         if self.eat_op("("):
             if self.at_kw("SELECT"):
-                sub = self.parse_select()
+                sub = self.parse_query_expr()
                 self.expect_op(")")
                 return _ScalarSubquery(sub)
             e = self.parse_expr()
@@ -836,6 +863,7 @@ class _Select:
     order_by: Optional[List[Tuple[Expression, bool, Optional[bool]]]] = None
     limit: Optional[int] = None
     union_of: Optional[Tuple["_Select", "_Select"]] = None
+    ctes: Optional[List] = None  # (name, col_aliases, _Select) triples
 
 
 def _conjuncts(e: Optional[Expression]) -> List[Expression]:
@@ -970,8 +998,27 @@ class Lowerer:
         self.session = session
         self._agg_counter = 0
         self._sq_counter = 0
+        # WITH-clause views: name -> lowered plan, shared across every
+        # reference in the statement (FROM and subqueries alike)
+        self._ctes: Dict[str, L.LogicalPlan] = {}
 
     def lower(self, sel: _Select) -> L.LogicalPlan:
+        for name, col_aliases, body in (sel.ctes or []):
+            plan = self.lower(body)
+            if col_aliases:
+                names = plan.schema().names
+                if len(col_aliases) != len(names):
+                    raise AnalysisError(
+                        f"CTE {name!r} declares {len(col_aliases)} "
+                        f"columns but its query yields {len(names)}")
+                plan = L.Project(plan, [Alias(ColumnRef(n), a)
+                                        for n, a in zip(names,
+                                                        col_aliases)])
+            self._ctes[name] = plan
+            # mark for the plan-fingerprint cache: a CTE referenced
+            # more than once (Q15's FROM + scalar subquery) materializes
+            # once on first use instead of re-executing per reference
+            self.session.mark_cache(plan)
         if sel.union_of is not None:
             plan = L.Union(self.lower(sel.union_of[0]),
                            self.lower(sel.union_of[1]))
@@ -1007,10 +1054,12 @@ class Lowerer:
     def _rel_plan(self, ref) -> L.LogicalPlan:
         if isinstance(ref, _Select):
             return self.lower(ref)
+        if ref in self._ctes:
+            return self._ctes[ref]
         if ref not in self.session.catalog:
             raise AnalysisError(
                 f"table {ref!r} not found; known: "
-                f"{sorted(self.session.catalog)}")
+                f"{sorted(self._ctes) + sorted(self.session.catalog)}")
         return L.Scan(self.session.catalog[ref])
 
     def _lower_from(self, sel: _Select):

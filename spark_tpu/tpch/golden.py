@@ -450,3 +450,20 @@ def q22(path: str) -> pd.DataFrame:
 
 GOLDEN["q11"] = _cached("q11", q11)
 GOLDEN["q22"] = _cached("q22", q22)
+
+
+def q15(path: str) -> pd.DataFrame:
+    l = _read(path, "lineitem")
+    s = _read(path, "supplier")
+    l = l[(l["l_shipdate"] >= pd.Timestamp("1996-01-01").date())
+          & (l["l_shipdate"] < pd.Timestamp("1996-04-01").date())]
+    rev = (l.assign(r=l["l_extendedprice"] * (1 - l["l_discount"]))
+           .groupby("l_suppkey", as_index=False).agg(total_revenue=("r", "sum")))
+    top = rev[rev["total_revenue"] == rev["total_revenue"].max()]
+    m = s.merge(top, left_on="s_suppkey", right_on="l_suppkey")
+    out = m[["s_suppkey", "s_name", "s_address", "s_phone",
+             "total_revenue"]].sort_values("s_suppkey")
+    return out.reset_index(drop=True)
+
+
+GOLDEN["q15"] = _cached("q15", q15)

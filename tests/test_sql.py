@@ -247,7 +247,7 @@ def test_decimal_float_compare_large_values(session):
 
 @pytest.mark.parametrize("qname", ["q4", "q7", "q8", "q9", "q10", "q11",
                                    "q12", "q13", "q14", "q16", "q17",
-                                   "q18", "q19", "q22"])
+                                   "q18", "q19", "q22", "q15"])
 def test_tpch_sql_extended(sql_session, qname):
     got = _norm(sql_session.sql(SQL_QUERIES[qname]).to_pandas())
     want = G.GOLDEN[qname](sql_session._tpch_path)
@@ -363,3 +363,28 @@ def test_exists_with_aggregate_raises(bounds):
 
 
 
+
+
+def test_cte_with_union_body(tiny):
+    got = tiny.sql("""
+        WITH u AS (
+            SELECT k FROM tiny WHERE k = 1
+            UNION ALL
+            SELECT k FROM other
+        )
+        SELECT k, count(*) AS c FROM u GROUP BY k ORDER BY k
+    """).to_pandas()
+    assert got["k"].tolist() == [1, 2, 4]
+    assert got["c"].tolist() == [4, 1, 1]
+
+
+def test_cte_multiple_references_share_materialization(tiny):
+    got = tiny.sql("""
+        WITH agg AS (
+            SELECT k, sum(v) AS sv FROM tiny GROUP BY k
+        )
+        SELECT k, sv FROM agg
+        WHERE sv = (SELECT max(sv) FROM agg)
+    """).to_pandas()
+    assert got["k"].tolist() == [1]
+    assert got["sv"].tolist() == [100.0]
