@@ -697,28 +697,25 @@ class Parser:
         raise ParseError(f"unknown function {name!r}")
 
 
-def _inner_alias_of(sub: "_Select") -> str:
-    ref, alias = sub.relations[0]
-    return alias or (ref if isinstance(ref, str) else "__sub")
-
-
-def _classify_side(e: Expression, inner_alias: str,
-                   inner_names) -> str:
+def _classify_side_multi(e: Expression, per_alias: dict,
+                         all_inner) -> str:
     """'inner' | 'outer' | 'mixed' | 'none' for a subquery conjunct,
     honoring table qualifiers (references() drops them, which
-    misclassified `bounds.k = tiny.k`-style correlation)."""
+    misclassified `bounds.k = tiny.k`-style correlation). Unqualified
+    names resolve inner-first (the inner scope shadows the outer)."""
     saw_inner = saw_outer = False
 
     def walk(node):
         nonlocal saw_inner, saw_outer
         if isinstance(node, _QualifiedRef):
-            if node.qualifier == inner_alias and node.col in inner_names:
+            names = per_alias.get(node.qualifier)
+            if names is not None and node.col in names:
                 saw_inner = True
             else:
                 saw_outer = True
             return
         if isinstance(node, ColumnRef):
-            if node.name() in inner_names:
+            if node.name() in all_inner:
                 saw_inner = True
             else:
                 saw_outer = True
@@ -1403,34 +1400,47 @@ class Lowerer:
 
     # -- subquery rewrites (reference: optimizer/subquery.scala) ------------
 
+    def _inner_universe(self, sub: _Select):
+        """(aliases, per-alias column names) over every FROM relation and
+        explicit join of a subquery — inner scope shadows outer for
+        unqualified names (standard SQL name resolution)."""
+        per_alias = {}
+        refs = list(sub.relations or [])
+        refs += [(ref, alias) for _how, ref, alias, _c
+                 in (sub.joins or [])]
+        for ref, alias in refs:
+            if isinstance(ref, _Select):
+                raise AnalysisError(
+                    "FROM subqueries inside correlated subqueries are "
+                    "not supported")
+            a = alias or ref
+            per_alias[a] = set(self._rel_plan(ref).schema().names)
+        return per_alias
+
     def _split_correlation(self, sub: _Select, outer_scope: _Scope):
-        """For a single-relation subquery, split its WHERE into local
-        conjuncts (rewritten to inner flat names) and
-        (outer_expr, inner_expr) equi-correlation pairs.
-        Returns (rel_ref, alias, local_conjuncts, pairs)."""
-        if not sub.relations or len(sub.relations) != 1 or sub.joins:
-            raise AnalysisError(
-                "correlated subqueries support a single FROM relation")
+        """Split a (possibly multi-relation) subquery's WHERE into local
+        conjuncts (RAW — the inner query's own lowering resolves them)
+        and (outer_expr_rewritten, inner_expr_raw) equi-correlation
+        pairs. Returns (local_conjuncts, pairs)."""
+        if not sub.relations:
+            raise AnalysisError("correlated subqueries need a FROM clause")
         if sub.group_by or sub.having or sub.limit is not None \
                 or sub.order_by:
             raise AnalysisError(
                 "GROUP BY/HAVING/ORDER BY/LIMIT inside a correlated "
                 "predicate subquery is not supported")
-        ref, alias = sub.relations[0]
-        inner_alias = _inner_alias_of(sub)
-        inner_plan = self._rel_plan(ref)
-        inner_scope = _Scope()
-        inner_scope.add(inner_alias, inner_plan.schema().names)
-        inner_names = set(inner_plan.schema().names)
+        per_alias = self._inner_universe(sub)
+        all_inner = set().union(*per_alias.values()) if per_alias else set()
 
         def side(e: Expression) -> str:
-            return _classify_side(e, inner_alias, inner_names)
+            return _classify_side_multi(e, per_alias, all_inner)
 
-        local, pairs = [], []
+        local, pairs, residuals = [], [], []
+        self._last_inner_universe = (per_alias, all_inner)
         for c in _conjuncts(sub.where):
             s = side(c)
             if s in ("inner", "none"):
-                local.append(inner_scope.rewrite(c))
+                local.append(c)
                 continue
             if isinstance(c, EQ):
                 a, b = c.children
@@ -1438,16 +1448,16 @@ class Lowerer:
                     if side(inner_e) == "inner" and \
                             side(outer_e) == "outer":
                         pairs.append((outer_scope.rewrite(outer_e),
-                                      inner_scope.rewrite(inner_e)))
+                                      inner_e))
                         break
                 else:
-                    raise AnalysisError(
-                        f"unsupported correlated conjunct: {c!r}")
+                    residuals.append(c)
             else:
-                raise AnalysisError(
-                    f"correlated subqueries support equi-correlation "
-                    f"only (got {c!r})")
-        return ref, alias, local, pairs
+                # non-equi correlation (e.g. l2.l_suppkey <> l1.l_suppkey
+                # in Q21): EXISTS carries these as a join residual; the
+                # scalar-aggregate rewrite cannot
+                residuals.append(c)
+        return local, pairs, residuals
 
     def _rewrite_subquery_conjunct(self, plan: L.LogicalPlan,
                                    c: Expression, scope: _Scope
@@ -1484,31 +1494,68 @@ class Lowerer:
                 raise AnalysisError(
                     "aggregates inside an EXISTS subquery are not "
                     "supported (the aggregate always yields one row)")
-            ref, _alias, local, pairs = self._split_correlation(
+            local, pairs, residuals = self._split_correlation(
                 e.select, scope)
             if not pairs:
+                if residuals:
+                    raise AnalysisError(
+                        "EXISTS with only non-equi correlation is not "
+                        "supported (at least one equi-correlated "
+                        "conjunct is required)")
                 raise AnalysisError(
                     "uncorrelated EXISTS is not supported (it is a "
                     "constant — filter host-side instead)")
-            inner = self._rel_plan(ref)
-            if local:
-                inner = L.Filter(inner, _and_all(local))
+            # project the correlation keys and lower the inner query
+            # normally (its own scope resolves qualified/local names;
+            # duplicates are harmless under a semi/anti join)
+            self._sq_counter += 1
+            sq = self._sq_counter
+            key_items = [(ie, f"__sq{sq}_key{i}")
+                         for i, (_oe, ie) in enumerate(pairs)]
+            # non-equi correlated conjuncts become the join's residual:
+            # inner leaf refs project as uniquely-aliased columns so the
+            # pair-batch condition never hits a rename collision
+            per_alias, all_inner = self._last_inner_universe
+            res_items: List[Tuple[Expression, str]] = []
+
+            def residualize(node: Expression) -> Expression:
+                if isinstance(node, (_QualifiedRef, ColumnRef)) and \
+                        _classify_side_multi(node, per_alias,
+                                             all_inner) == "inner":
+                    alias = f"__sq{sq}_res{len(res_items)}"
+                    res_items.append((node, alias))
+                    return ColumnRef(alias)
+                if isinstance(node, (_QualifiedRef, ColumnRef)):
+                    return scope.rewrite(node)
+                return node.map_children(residualize)
+
+            residual_cond = None
+            if residuals:
+                residual_cond = _and_all([residualize(c)
+                                          for c in residuals])
+            inner_sel = _Select(items=list(key_items) + res_items,
+                                relations=list(e.select.relations),
+                                joins=list(e.select.joins or []),
+                                where=_and_all(local))
+            inner = self.lower(inner_sel)
             how = "left_anti" if negate else "left_semi"
             return L.Join(plan, inner, [p[0] for p in pairs],
-                          [p[1] for p in pairs], how)
+                          [ColumnRef(nm) for _ie, nm in key_items], how,
+                          condition=residual_cond)
 
         # comparison (or expression) containing scalar subqueries
         return self._rewrite_scalar_in_conjunct(plan, c, scope)
 
     def _subquery_is_correlated(self, sub: _Select) -> bool:
-        if not (sub.relations and len(sub.relations) == 1
-                and not sub.joins):
+        if not sub.relations:
             return False
-        inner_alias = _inner_alias_of(sub)
-        inner_names = set(
-            self._rel_plan(sub.relations[0][0]).schema().names)
+        try:
+            per_alias = self._inner_universe(sub)
+        except AnalysisError:
+            return False  # FROM-subquery inners: treated uncorrelated
+        all_inner = set().union(*per_alias.values()) if per_alias else set()
         return any(
-            _classify_side(cc, inner_alias, inner_names)
+            _classify_side_multi(cc, per_alias, all_inner)
             in ("outer", "mixed")
             for cc in _conjuncts(sub.where))
 
@@ -1522,8 +1569,12 @@ class Lowerer:
                     return L.ScalarSubqueryExpr(self.lower(sub))
                 # correlated scalar aggregate -> grouped aggregate joined
                 # on the correlation keys (RewriteCorrelatedScalarSubquery)
-                ref, alias, local, pairs = self._split_correlation(
+                local, pairs, residuals = self._split_correlation(
                     sub, scope)
+                if residuals:
+                    raise AnalysisError(
+                        "correlated scalar subqueries support "
+                        "equi-correlation only")
                 if len(sub.items or []) != 1:
                     raise AnalysisError(
                         "correlated scalar subquery needs exactly one "
@@ -1540,7 +1591,8 @@ class Lowerer:
                 inner_sel = _Select(
                     items=[(ie, nm) for ie, nm in key_items]
                     + [(sub.items[0][0], val_name)],
-                    relations=[(ref, alias)],
+                    relations=list(sub.relations),
+                    joins=list(sub.joins or []),
                     where=_and_all(local),
                     group_by=[ie for ie, _nm in key_items])
                 sub_plan = self.lower(inner_sel)

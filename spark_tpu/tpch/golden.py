@@ -467,3 +467,76 @@ def q15(path: str) -> pd.DataFrame:
 
 
 GOLDEN["q15"] = _cached("q15", q15)
+
+
+def q2(path: str) -> pd.DataFrame:
+    p = _read(path, "part")
+    s = _read(path, "supplier")
+    ps = _read(path, "partsupp")
+    n = _read(path, "nation")
+    r = _read(path, "region")
+    r = r[r["r_name"] == "EUROPE"]
+    base = (ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+            .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+            .merge(r, left_on="n_regionkey", right_on="r_regionkey"))
+    min_cost = base.groupby("ps_partkey")["ps_supplycost"].min()
+    p = p[(p["p_size"] == 15) & p["p_type"].str.contains("TYPE 2",
+                                                         regex=False)]
+    m = base.merge(p, left_on="ps_partkey", right_on="p_partkey")
+    m = m[m["ps_supplycost"] == m["ps_partkey"].map(min_cost)]
+    out = (m.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                         ascending=[False, True, True, True]).head(100))
+    cols = ["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+            "s_address", "s_phone", "s_comment"]
+    return out[cols].reset_index(drop=True)
+
+
+GOLDEN["q2"] = _cached("q2", q2)
+
+
+def q20(path: str) -> pd.DataFrame:
+    s = _read(path, "supplier")
+    n = _read(path, "nation")
+    p = _read(path, "part")
+    ps = _read(path, "partsupp")
+    l = _read(path, "lineitem")
+    parts = p[p["p_name"].str.startswith("part name 5")]["p_partkey"]
+    l = l[(l["l_shipdate"] >= pd.Timestamp("1994-01-01").date())
+          & (l["l_shipdate"] < pd.Timestamp("1995-01-01").date())]
+    half = (l.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum()
+            * 0.5)
+    m = ps[ps["ps_partkey"].isin(parts)].copy()
+    key = list(zip(m["ps_partkey"], m["ps_suppkey"]))
+    m = m[m["ps_availqty"] > pd.Series(key, index=m.index).map(half)]
+    sel = s[s["s_suppkey"].isin(m["ps_suppkey"])]
+    sel = sel.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    sel = sel[sel["n_name"] == "CANADA"]
+    out = sel[["s_name", "s_address"]].sort_values("s_name")
+    return out.reset_index(drop=True)
+
+
+GOLDEN["q20"] = _cached("q20", q20)
+
+
+def q21(path: str) -> pd.DataFrame:
+    s = _read(path, "supplier")
+    l = _read(path, "lineitem")
+    o = _read(path, "orders")
+    n = _read(path, "nation")
+    late = l[l["l_receiptdate"] > l["l_commitdate"]]
+    n_supp = l.groupby("l_orderkey")["l_suppkey"].nunique()
+    n_late_supp = late.groupby("l_orderkey")["l_suppkey"].nunique()
+    m = (late.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(o[o["o_orderstatus"] == "F"], left_on="l_orderkey",
+                right_on="o_orderkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    m = m[m["n_name"] == "SAUDI ARABIA"]
+    m = m[(m["l_orderkey"].map(n_supp) > 1)
+          & (m["l_orderkey"].map(n_late_supp) == 1)]
+    out = (m.groupby("s_name").size().reset_index(name="numwait")
+           .sort_values(["numwait", "s_name"], ascending=[False, True])
+           .head(100))
+    return out[["s_name", "numwait"]].reset_index(drop=True)
+
+
+GOLDEN["q21"] = _cached("q21", q21)

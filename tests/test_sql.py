@@ -247,7 +247,8 @@ def test_decimal_float_compare_large_values(session):
 
 @pytest.mark.parametrize("qname", ["q4", "q7", "q8", "q9", "q10", "q11",
                                    "q12", "q13", "q14", "q16", "q17",
-                                   "q18", "q19", "q22", "q15"])
+                                   "q18", "q19", "q22", "q15", "q2",
+                                   "q20", "q21"])
 def test_tpch_sql_extended(sql_session, qname):
     got = _norm(sql_session.sql(SQL_QUERIES[qname]).to_pandas())
     want = G.GOLDEN[qname](sql_session._tpch_path)
@@ -388,3 +389,6 @@ def test_cte_multiple_references_share_materialization(tiny):
     """).to_pandas()
     assert got["k"].tolist() == [1]
     assert got["sv"].tolist() == [100.0]
+
+
+
