@@ -106,6 +106,14 @@ AGG_SORT_FALLBACK = register(
     doc="Max combined integer key domain for the direct scatter-add "
         "aggregate fast path; larger domains use the sort-based aggregate.")
 
+AGG_KERNEL_MODE = register(
+    "spark_tpu.sql.aggregate.kernelMode", "auto",
+    doc="Dense-domain aggregate update kernel: 'auto' picks the Pallas "
+        "MXU one-hot matmul on TPU and XLA scatter elsewhere; 'matmul' / "
+        "'scatter' force a path (matmul off-TPU runs the Pallas kernel "
+        "in interpret mode — slow, for tests).",
+    validator=lambda v: v in ("auto", "matmul", "scatter"))
+
 AGG_TABLE_SIZE = register(
     "spark_tpu.sql.aggregate.estimatedGroups", 1 << 16,
     doc="Estimated distinct group count used to size hash-aggregate output "

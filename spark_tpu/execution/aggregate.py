@@ -119,13 +119,19 @@ def direct_init(spans: Sequence[int], specs: List[List[AccSpec]]):
 
 
 def direct_update(tables, idx, total, contribs: List[List],
-                  specs: List[List[AccSpec]], kernel_mode: str = "auto"):
+                  specs: List[List[AccSpec]], kernel_mode: str = "auto",
+                  merge: bool = False):
     """Merge one chunk's contributions into carried tables (associative).
 
     kernel_mode: 'auto' uses the Pallas MXU one-hot matmul kernel on TPU
     (XLA scatter-add with colliding indices is ~100x slower there) and
     plain scatter elsewhere; 'matmul'/'scatter' force a path ('matmul'
     off-TPU runs the kernel in interpret mode, for tests).
+
+    merge=True means the contributions are PARTIAL ACCUMULATORS (a final
+    -mode aggregate folding per-shard tables), not raw per-row values:
+    AccSpec.width bounds only the raw update, so merge forces full
+    64-bit limbs — a partial count easily exceeds 2^8.
     """
     cnt, accs = tables
     if np.ndim(idx) == 0:
@@ -142,7 +148,8 @@ def direct_update(tables, idx, total, contribs: List[List],
         # the VPU masked-reduce float path costs O(domain) per row; the
         # factorized MXU kernel is int-only — scatter instead
         use_kernel = False
-    if all_sum and use_kernel and total <= (1 << 20) and idx.shape[0] >= 128:
+    if all_sum and use_kernel and total <= (1 << 20) and \
+            (idx.shape[0] >= 128 or kernel_mode == "matmul"):
         from .pallas_groupby import dense_groupby_sums
         int_rows = [jnp.ones(idx.shape, jnp.int64)]
         int_widths = [8]  # the occupancy count contributes 0/1
@@ -156,7 +163,7 @@ def direct_update(tables, idx, total, contribs: List[List],
                 else:
                     layout.append(("i", len(int_rows)))
                     int_rows.append(contrib.astype(jnp.int64))
-                    int_widths.append(spec.width)
+                    int_widths.append(64 if merge else spec.width)
         int_sums, float_sums = dense_groupby_sums(
             idx, int_rows, float_rows, total,
             interpret=(backend != "tpu"), int_widths=int_widths)
@@ -222,12 +229,14 @@ def direct_aggregate(key_vecs: Sequence[Vec],
                      domains: Sequence[Tuple[int, int]],
                      spans: Sequence[int],
                      contribs: List[List], specs: List[List[AccSpec]],
-                     sel) -> Tuple[List, List, List, object]:
+                     sel, kernel_mode: str = "auto",
+                     merge: bool = False) -> Tuple[List, List, List, object]:
     """One-shot dense-domain aggregation.
     Returns (key_arrays, key_valids, acc_arrays, occupied)."""
     idx, total, strides = direct_index(key_vecs, domains, spans, sel)
     tables = direct_init(spans, specs)
-    cnt, accs = direct_update(tables, idx, total, contribs, specs)
+    cnt, accs = direct_update(tables, idx, total, contribs, specs,
+                              kernel_mode=kernel_mode, merge=merge)
     key_arrays, key_valids = direct_keys(domains, spans, strides,
                                          [v.dtype for v in key_vecs])
     return key_arrays, key_valids, accs, cnt > 0

@@ -177,7 +177,7 @@ def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,
                     chain, ctx,
                     _range_chunk(leaf, i.astype(jnp.int64) * chunk_rows,
                                  chunk_rows, rows_total))
-                return agg.direct_update_tables(tables, b, prep)
+                return agg.direct_update_tables(tables, b, prep, conf)
 
             tables = jax.lax.fori_loop(0, n_chunks, body,
                                        agg.direct_init_tables(prep))
@@ -228,7 +228,7 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
                 def update(tables, b, bb):
                     ctx = P.ExecContext(conf)
                     b = _replay_chain(chain, ctx, b, bb)
-                    new = agg.direct_update_tables(tables, b, prep0)
+                    new = agg.direct_update_tables(tables, b, prep0, conf)
                     return new, ctx.flags, ctx.metrics
 
                 # no donation: a join-capacity overflow must re-run the
@@ -238,7 +238,7 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
                 def update(tables, b):
                     ctx = P.ExecContext(conf)
                     b = _replay_chain(chain, ctx, b)
-                    return agg.direct_update_tables(tables, b, prep0)
+                    return agg.direct_update_tables(tables, b, prep0, conf)
 
                 # join-free hot path: donate tables, no per-chunk host
                 # sync — the double-buffered host->HBM overlap
@@ -385,7 +385,7 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
             t = jax.tree_util.tree_map(lambda x: x[0], tables)
             ctx = P.ExecContext(conf)
             local = _replay_chain(chain, ctx, b)
-            new = agg.direct_update_tables(t, local, prep)
+            new = agg.direct_update_tables(t, local, prep, conf)
             return jax.tree_util.tree_map(lambda x: x[None], new)
 
         def emit(tables):

@@ -473,7 +473,10 @@ class HashAggregateExec(UnaryExec):
         if use_direct:
             key_arrays, key_valids, accs, occupied = \
                 agg_kernels.direct_aggregate(
-                    key_vecs, domains, spans, contribs, specs, sel)
+                    key_vecs, domains, spans, contribs, specs, sel,
+                    kernel_mode=str(ctx.conf.get(
+                        "spark_tpu.sql.aggregate.kernelMode")),
+                    merge=(self.mode == "final"))
         else:
             num_segments = batch.capacity
             if self.est_groups and self.group_exprs:
@@ -560,8 +563,10 @@ class HashAggregateExec(UnaryExec):
         idx, _, _ = agg_kernels.direct_index(key_vecs, prep.domains,
                                              prep.spans, sel)
         contribs = [a.func.update(batch, sel) for a in self.agg_exprs]
+        mode = str(conf.get("spark_tpu.sql.aggregate.kernelMode")) \
+            if conf is not None else "auto"
         return agg_kernels.direct_update(tables, idx, prep.total, contribs,
-                                         prep.specs)
+                                         prep.specs, kernel_mode=mode)
 
     def direct_finalize_tables(self, tables, prep: "DirectAggPlan",
                                dict_overrides: Optional[Dict] = None) -> Batch:

@@ -297,7 +297,8 @@ class StreamingQuery:
             ctx = ExecContext(self.session.conf)
             for op in reversed(self._chain):
                 b = op.compute(ctx, [b])
-            return self._agg_exec.direct_update_tables(tables, b, prep)
+            return self._agg_exec.direct_update_tables(
+                tables, b, prep, self.session.conf)
 
         # one jitted step per trigger (no donation: a save failure must
         # leave the PRE-update tables alive for an exact replay)

@@ -142,6 +142,56 @@ def test_groupby_negative_bytes(session):
     assert list(out["s"]) == [1, 7, 3, 4]
 
 
+def test_final_merge_kernel_full_width():
+    """Round-3 ADVICE high: the MXU kernel bounded per-row limbs by
+    AccSpec.width in ALL modes, but final-mode contributions are partial
+    accumulators (counts in the thousands with width=8) — counts came
+    back mod 256. merge=True must force full 64-bit limbs."""
+    import jax.numpy as jnp
+    from spark_tpu.execution import aggregate as K
+    from spark_tpu.expr import Vec
+    from spark_tpu.expr_agg import AccSpec
+    import spark_tpu.types as T
+
+    n = 160  # > the kernel's small-input gate when matmul is forced
+    keys = Vec(jnp.arange(n, dtype=jnp.int64) % 4, T.LONG, None, None)
+    specs = [[AccSpec("count", np.dtype(np.int64), "sum", width=8)],
+             [AccSpec("sum", np.dtype(np.int64), "sum", width=16)]]
+    # partial counts of 1000 (> 2^8) and partial sums of 1<<40 (> 2^16)
+    contribs = [[jnp.full((n,), 1000, jnp.int64)],
+                [jnp.full((n,), 1 << 40, jnp.int64)]]
+    domains = [(4, 0)]
+    spans = [4]
+    _, _, accs, _ = K.direct_aggregate(
+        [keys], domains, spans, contribs, specs, None,
+        kernel_mode="matmul", merge=True)
+    assert np.asarray(accs[0][0]).tolist() == [1000 * 40] * 4
+    assert np.asarray(accs[1][0]).tolist() == [(1 << 40) * 40] * 4
+
+
+def test_two_phase_mesh_agg_forced_matmul(session):
+    """End-to-end: a distributed two-phase aggregate with the Pallas
+    kernel forced (interpret mode on CPU) must match the single-chip
+    scatter result — >256 rows per group per shard so a width-bounded
+    merge would truncate."""
+    mesh_key = "spark_tpu.sql.mesh.size"
+    kern_key = "spark_tpu.sql.aggregate.kernelMode"
+    n = 40_000  # 5 groups -> 8000 rows/group, ~1000/group/shard
+    build = lambda: (session.range(n)
+                     .group_by((col("id") % 5).alias("k"))
+                     .agg(F.count().alias("c"), F.sum(col("id")).alias("s")))
+    want = build().to_pandas().sort_values("k").reset_index(drop=True)
+    try:
+        session.conf.set(mesh_key, 8)
+        session.conf.set(kern_key, "matmul")
+        got = build().to_pandas().sort_values("k").reset_index(drop=True)
+    finally:
+        session.conf.set(mesh_key, 0)
+        session.conf.set(kern_key, "auto")
+    assert got["c"].tolist() == want["c"].tolist() == [8000] * 5
+    assert got["s"].tolist() == want["s"].tolist()
+
+
 def test_prune_columns_preserves_join_renames(session):
     """Plan-level: pruning must not change join output names — the
     colliding left column that forced an `_r` suffix stays alive
