@@ -141,6 +141,14 @@ STREAMING_CHUNK_ROWS = register(
         "the way the reference's row-iterator pipeline does. (1<<26 "
         "chunks faulted the v5e runtime on wide-domain aggregates.)")
 
+DEVICE_CACHE_BYTES = register(
+    "spark_tpu.sql.io.deviceCacheBytes", 6 << 30,
+    doc="Byte budget for the device-resident table cache: loaded scans "
+        "(post column-prune/filter-pushdown) stay in HBM and are reused "
+        "across queries, LRU-evicted past the budget. 0 disables. The "
+        "storage-memory-pool analog of UnifiedMemoryManager.scala:49 + "
+        "CacheManager.scala.")
+
 ADAPTIVE_ENABLED = register(
     "spark_tpu.sql.adaptive.enabled", True,
     doc="Enable the stats->re-jit retry loop for join/exchange/aggregate "

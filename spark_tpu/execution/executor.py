@@ -286,6 +286,52 @@ class QueryExecution:
         self.session._stage_cache[key] = fn
         return fn
 
+    def _aqe_cache_key(self, mesh) -> Optional[str]:
+        """Plan + data-identity key for persisted AQE capacities; None
+        (uncacheable) when any scan's source has no identity stamp."""
+        tokens = []
+
+        def walk(node):
+            if isinstance(node, L.Scan):
+                tokens.append(node.source.cache_token())
+            for c in node.children:
+                walk(c)
+
+        walk(self.optimized_plan)
+        if any(t is None for t in tokens):
+            return None
+        n = int(mesh.devices.size) if mesh is not None else 1
+        return (self.optimized_plan.tree_string()
+                + f"#mesh{n}#src{tokens!r}")
+
+    @staticmethod
+    def _collect_caps(root: P.PhysicalPlan, out: Dict[str, int]) -> None:
+        """Harvest every AQE-discovered static capacity from a converged
+        plan, keyed `kind:tag` (the persistence side of the stats
+        channel: the reference re-learns MapOutputStatistics per query,
+        but its shuffle files are sized dynamically — XLA's static
+        shapes make remembering converged capacities the difference
+        between one compile and a compile per retry per execution)."""
+        for c in root.children:
+            QueryExecution._collect_caps(c, out)
+        if isinstance(root, P.JoinExec) and root.out_cap is not None:
+            out[f"join:{root.tag}"] = root.out_cap
+        elif isinstance(root, P.ExchangeExec) and root.block_cap is not None:
+            out[f"exch:{root.tag}"] = root.block_cap
+        elif isinstance(root, P.HashAggregateExec) and root.est_groups:
+            out[f"agg:{root.tag}"] = root.est_groups
+
+    def _apply_saved_caps(self, root: P.PhysicalPlan, caps: Dict[str, int]
+                          ) -> None:
+        for key, cap in caps.items():
+            kind, tag = key.split(":", 1)
+            if kind == "join":
+                self._set_join_cap(root, tag, cap)
+            elif kind == "exch":
+                self._set_exchange_cap(root, tag, cap)
+            else:
+                self._set_agg_groups(root, tag, cap)
+
     @staticmethod
     def _set_join_cap(root: P.PhysicalPlan, tag: str, cap: int) -> None:
         for c in root.children:
@@ -319,12 +365,30 @@ class QueryExecution:
         from ..parallel.mesh import get_mesh
         self._activate_conf()
         mesh = get_mesh(self.session.conf)
+        # seed capacities a previous execution of this plan discovered,
+        # so repeated queries skip the overflow->re-jit ramp entirely.
+        # The key includes every scan's source identity stamp: caps
+        # learned on old data must not seed (possibly too small) after a
+        # table is re-registered or a file rewritten.
+        aqe_key = self._aqe_cache_key(mesh)
+        saved_caps = self.session._aqe_caps.get(aqe_key) \
+            if aqe_key is not None else None
+        if saved_caps:
+            self._apply_saved_caps(self.executed_plan, saved_caps)
+        t0 = time.perf_counter()
         root = self._materialize_streaming(self.executed_plan, mesh)
+        dt = time.perf_counter() - t0
+        if root is not self.executed_plan:
+            # chunked ingest + chunk compute happen inside the splice
+            self.phase_times["streaming"] = dt
         scans: List[P.LeafExec] = []
         self._collect_scans(root, scans)
 
         t0 = time.perf_counter()
-        scan_batches = [s.load() for s in scans]
+        from ..io.device_cache import load_scan
+        scan_batches = [load_scan(s, self.session.conf)
+                        if isinstance(s, P.ScanExec) else s.load()
+                        for s in scans]
         if mesh is not None:
             from ..parallel import pad_batch_to_multiple
             n = int(mesh.devices.size)
@@ -347,11 +411,15 @@ class QueryExecution:
                     batch, flags, metrics = fn(scan_batches)
                 else:
                     batch, flags, metrics = fn(scan_batches, token)
+                # ONE batched host pull for the whole stats channel —
+                # per-scalar np.asarray costs an RPC round trip each on
+                # tunneled runtimes
+                flags, metrics = jax.device_get((flags, metrics))
                 overflow = [k for k, v in flags.items()
                             if k.startswith(("join_overflow_",
                                              "exch_overflow_",
                                              "agg_overflow_"))
-                            and bool(np.asarray(v))]
+                            and bool(v)]
                 if not overflow:
                     break
                 if not adaptive:
@@ -362,18 +430,17 @@ class QueryExecution:
                 for k in overflow:
                     if k.startswith("join_overflow_"):
                         tag = k[len("join_overflow_"):]
-                        total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                        total = int(metrics[f"join_rows_{tag}"])
                         self._set_join_cap(root, tag,
                                            bucket_capacity(max(total, 8)))
                     elif k.startswith("exch_overflow_"):
                         tag = k[len("exch_overflow_"):]
-                        mx = int(np.asarray(metrics[f"exch_max_{tag}"]))
+                        mx = int(metrics[f"exch_max_{tag}"])
                         self._set_exchange_cap(root, tag,
                                                bucket_capacity(max(mx, 8)))
                     else:
                         tag = k[len("agg_overflow_"):]
-                        total = int(np.asarray(
-                            metrics[f"agg_groups_{tag}"]))
+                        total = int(metrics[f"agg_groups_{tag}"])
                         self._set_agg_groups(root, tag, max(total, 8))
             else:
                 raise RuntimeError(
@@ -381,8 +448,21 @@ class QueryExecution:
                     f"overflowing: {overflow}")
         batch = jax.block_until_ready(batch)
         self.phase_times["execution"] = time.perf_counter() - t0
-        self.last_metrics = {k: int(np.asarray(v))
-                             for k, v in metrics.items()}
+        if aqe_key is not None:
+            # harvest from the UNSPLICED plan: streamed-aggregate joins
+            # mutated their caps on the original nodes, which the
+            # spliced `root` no longer contains. Merge (don't replace)
+            # so a streamed run doesn't drop caps a whole-input run
+            # learned, and bound the cache (plan strings are big).
+            converged: Dict[str, int] = {}
+            self._collect_caps(self.executed_plan, converged)
+            self._collect_caps(root, converged)
+            if converged:
+                store = self.session._aqe_caps
+                store.setdefault(aqe_key, {}).update(converged)
+                while len(store) > 256:
+                    store.pop(next(iter(store)))
+        self.last_metrics = {k: int(v) for k, v in metrics.items()}
         # fill the data cache on the first action over a marked plan
         fp = self.session._plan_fingerprint(self.logical)
         if fp in self.session._cache_requests and \

@@ -85,7 +85,9 @@ def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
             collect(c)
 
     collect(root)
-    inputs = [s.load() for s in scans]
+    from ..io.device_cache import load_scan
+    inputs = [load_scan(s, conf) if isinstance(s, P.ScanExec) else s.load()
+              for s in scans]
     # the executor's capacity setters, so every overflow family the main
     # AQE loop knows (join/exchange/aggregate) retries here too
     from .executor import QueryExecution
@@ -107,10 +109,11 @@ def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
             return out, ctx.flags, ctx.metrics
 
         batch, flags, metrics = jax.jit(run)(inputs)
+        flags, metrics = jax.device_get((flags, metrics))
         overflow = [k for k, v in flags.items()
                     if k.startswith(("join_overflow_", "exch_overflow_",
                                      "agg_overflow_"))
-                    and bool(np.asarray(v))]
+                    and bool(v)]
         if not overflow:
             return batch
         if not adaptive:
@@ -120,17 +123,17 @@ def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
         for k in overflow:
             if k.startswith("join_overflow_"):
                 tag = k[len("join_overflow_"):]
-                total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                total = int(metrics[f"join_rows_{tag}"])
                 QueryExecution._set_join_cap(
                     root, tag, bucket_capacity(max(total, 8)))
             elif k.startswith("exch_overflow_"):
                 tag = k[len("exch_overflow_"):]
-                mx = int(np.asarray(metrics[f"exch_max_{tag}"]))
+                mx = int(metrics[f"exch_max_{tag}"])
                 QueryExecution._set_exchange_cap(
                     root, tag, bucket_capacity(max(mx, 8)))
             else:
                 tag = k[len("agg_overflow_"):]
-                total = int(np.asarray(metrics[f"agg_groups_{tag}"]))
+                total = int(metrics[f"agg_groups_{tag}"])
                 QueryExecution._set_agg_groups(root, tag, max(total, 8))
     raise RuntimeError("build-side capacity did not converge")
 
@@ -263,14 +266,15 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
             return update_fn(tables, b)
         for _attempt in range(8):
             new, flags, metrics = update_fn(tables, b, builds)
+            flags, metrics = jax.device_get((flags, metrics))
             overflow = [k for k, v in flags.items()
                         if k.startswith("join_overflow_")
-                        and bool(np.asarray(v))]
+                        and bool(v)]
             if not overflow:
                 return new
             for k in overflow:
                 tag = k[len("join_overflow_"):]
-                total = int(np.asarray(metrics[f"join_rows_{tag}"]))
+                total = int(metrics[f"join_rows_{tag}"])
                 for j in joins:
                     if j.tag == tag:
                         j.out_cap = bucket_capacity(max(total, 8))
@@ -365,6 +369,8 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     est = leaf.source.estimated_rows()
     if est is not None and est <= chunk_rows:
         return None
+    if _prefer_resident(leaf, conf):
+        return None
 
     n = int(mesh.devices.size)
     chunks = leaf.source.load_chunks(leaf.required_columns,
@@ -432,6 +438,24 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     return batch
 
 
+def _prefer_resident(leaf: "P.ScanExec", conf) -> bool:
+    """True when the scan should load whole and ride the device-table
+    cache instead of streaming: it's already cached, or its estimated
+    footprint fits in half the cache budget (so repeated queries skip
+    host ingest entirely — the round-3 headline perf fix)."""
+    from ..io.device_cache import (CACHE_BYTES_KEY, estimated_scan_bytes,
+                                   is_cached, scan_cache_key)
+    budget = int(conf.get(CACHE_BYTES_KEY))
+    if budget <= 0:
+        return False
+    if scan_cache_key(leaf) is None:
+        return False  # uncacheable source: residency would re-ingest
+    if is_cached(leaf):
+        return True
+    est_b = estimated_scan_bytes(leaf)
+    return est_b is not None and est_b <= budget // 2
+
+
 def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
                          cache: Optional[dict] = None) -> Optional[Batch]:
     if agg.mode != "complete":
@@ -453,5 +477,7 @@ def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
     if est is not None and est <= chunk_rows:
         return None
     if not hasattr(leaf.source, "load_chunks"):
+        return None
+    if _prefer_resident(leaf, conf):
         return None
     return stream_scan_aggregate(agg, chain, leaf, conf, cache)

@@ -1,0 +1,144 @@
+"""Device-resident table cache: loaded scans stay in HBM across queries.
+
+The round-3 headline perf failure was re-ingesting every scan on every
+execution (full Parquet read + dictionary-encode + device_put per
+query). The reference avoids this with `CacheManager.scala:1`'s
+plan-fingerprint cache and the BlockManager's storage tier; here the
+analog is a process-level LRU over loaded device Batches keyed on
+(source identity stamp, pruned columns, pushed filters), with a byte
+budget (`spark_tpu.sql.io.deviceCacheBytes`) — HBM is the storage
+memory pool of `UnifiedMemoryManager.scala:49`, with LRU eviction
+playing the role of its storage-eviction policy.
+
+Source identity stamps make staleness structural rather than
+time-based: an Arrow-backed source gets a fresh monotonic token per
+source object (re-registering a table name creates a new source, so
+stale hits are impossible), and a Parquet source stamps the file list
+with (size, mtime) pairs, so rewritten files miss the cache.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+CACHE_BYTES_KEY = "spark_tpu.sql.io.deviceCacheBytes"
+
+
+def batch_nbytes(batch) -> int:
+    total = 0
+    for col in batch.columns.values():
+        total += getattr(col.data, "nbytes", 0)
+        if col.validity is not None:
+            total += getattr(col.validity, "nbytes", 0)
+    sel = batch.selection
+    if sel is not None:
+        total += getattr(sel, "nbytes", 0)
+    return total
+
+
+class DeviceTableCache:
+    """LRU cache of loaded device Batches with a byte budget."""
+
+    def __init__(self):
+        self._entries: "OrderedDict[Tuple, Tuple[object, int]]" = \
+            OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key) -> Optional[object]:
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return entry[0]
+
+    def put(self, key, batch, budget: int) -> None:
+        nbytes = batch_nbytes(batch)
+        if nbytes > budget:
+            return  # larger than the whole budget: don't thrash
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old[1]
+        self._entries[key] = (batch, nbytes)
+        self._bytes += nbytes
+        while self._bytes > budget and len(self._entries) > 1:
+            _, (_, evicted) = self._entries.popitem(last=False)
+            self._bytes -= evicted
+
+    def invalidate_token(self, token) -> None:
+        """Drop every entry whose source stamp is `token`."""
+        for k in [k for k in self._entries if k[0] == token]:
+            _, nbytes = self._entries.pop(k)
+            self._bytes -= nbytes
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+
+#: process-level cache (the session is effectively a singleton; HBM is a
+#: process resource either way, like the reference's block manager)
+CACHE = DeviceTableCache()
+
+
+def scan_cache_key(scan) -> Optional[Tuple]:
+    """Cache key for a ScanExec, or None when the source is uncacheable."""
+    token = scan.source.cache_token()
+    if token is None:
+        return None
+    cols = None if scan.required_columns is None \
+        else tuple(scan.required_columns)
+    filters = tuple(repr(f) for f in scan.pushed_filters)
+    return (token, cols, filters)
+
+
+def estimated_scan_bytes(scan) -> Optional[int]:
+    """Rough post-prune device footprint of a scan (for the stream-vs-
+    resident decision): rows x per-column width, with 2x headroom for
+    capacity bucketing. None when the source can't estimate rows."""
+    from .. import types as T
+    est = scan.source.estimated_rows()
+    if est is None:
+        return None
+    width = 0
+    for f in scan.schema().fields:
+        if isinstance(f.dtype, T.StringType):
+            width += 4  # dictionary codes (dictionary bytes stay host-side)
+        elif isinstance(f.dtype, T.DecimalType):
+            width += 16
+        elif isinstance(f.dtype, (T.IntegerType, T.DateType, T.FloatType)):
+            width += 4
+        elif isinstance(f.dtype, T.BooleanType):
+            width += 1
+        else:
+            width += 8
+        if f.nullable:
+            width += 1
+    return 2 * est * width
+
+
+def is_cached(scan) -> bool:
+    key = scan_cache_key(scan)
+    return key is not None and key in CACHE._entries
+
+
+def load_scan(scan, conf) -> object:
+    """Load a ScanExec's Batch through the device cache."""
+    budget = int(conf.get(CACHE_BYTES_KEY))
+    key = scan_cache_key(scan) if budget > 0 else None
+    if key is not None:
+        batch = CACHE.get(key)
+        if batch is not None:
+            return batch
+    batch = scan.load()
+    if key is not None:
+        CACHE.put(key, batch, budget)
+    return batch

@@ -111,6 +111,11 @@ class TableSource:
     def estimated_rows(self) -> Optional[int]:
         return None
 
+    def cache_token(self):
+        """Identity stamp for the device-table cache; None = uncacheable.
+        Must change whenever the underlying data can differ."""
+        return None
+
 
 def _arrow_schema_to_engine(schema: pa.Schema) -> T.Schema:
     from ..columnar import _ARROW_TO_DTYPE
@@ -215,12 +220,23 @@ class ChunkIterator:
         return Batch.from_arrow(chunk, capacity=self._capacity)
 
 
+import itertools
+
+_SOURCE_TOKENS = itertools.count()
+
+
 class ArrowTableSource(TableSource):
     """In-memory table (the reference's LocalRelation / InMemoryRelation)."""
 
     def __init__(self, name: str, table: pa.Table):
         self.name = name
         self.table = table
+        # fresh per-source stamp: re-registering a name builds a new
+        # source object, so a stale device-cache hit is impossible
+        self._cache_token = ("arrow", next(_SOURCE_TOKENS))
+
+    def cache_token(self):
+        return self._cache_token
 
     def schema(self) -> T.Schema:
         return _arrow_schema_to_engine(self.table.schema)
@@ -291,6 +307,18 @@ class ParquetSource(TableSource):
         self.path = path
         self.name = name or os.path.basename(path).split(".")[0]
         self._dataset = pa_dataset.dataset(path, format="parquet")
+
+    def cache_token(self):
+        """(path, per-file (size, mtime_ns)) stamp: rewriting any file in
+        the dataset invalidates cached device tables for it."""
+        stamps = []
+        try:
+            for f in self._dataset.files:
+                st = os.stat(f)
+                stamps.append((f, st.st_size, st.st_mtime_ns))
+        except OSError:
+            return None
+        return ("parquet", self.path, tuple(stamps))
 
     def schema(self) -> T.Schema:
         return _arrow_schema_to_engine(self._dataset.schema)

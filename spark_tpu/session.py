@@ -30,6 +30,10 @@ class SparkTpuSession:
         # action; later plans substitute equal subtrees with cached scans
         self._cache_requests: Dict[str, object] = {}  # fp -> LogicalPlan
         self._data_cache: Dict[str, pa.Table] = {}
+        # plan-fingerprint -> {kind:tag -> capacity} discovered by the
+        # AQE overflow loop; repeated executions seed these and skip the
+        # overflow->re-jit ramp
+        self._aqe_caps: Dict[str, Dict[str, int]] = {}
         SparkTpuSession._active = self
 
     # -- data cache ---------------------------------------------------------

@@ -248,6 +248,10 @@ def test_streamed_join_aggregate(session):
     SA.stream_scan_aggregate = spy
     prev = session.conf.get("spark_tpu.sql.execution.streamingChunkRows")
     session.conf.set("spark_tpu.sql.execution.streamingChunkRows", 1024)
+    # the device-table cache would keep this (tiny) scan resident and
+    # skip streaming entirely; disable it to exercise the chunked path
+    prev_cache = session.conf.get("spark_tpu.sql.io.deviceCacheBytes")
+    session.conf.set("spark_tpu.sql.io.deviceCacheBytes", 0)
     try:
         got = (session.table("sj_fact")
                .join(session.table("sj_dim"), on="fk")
@@ -257,6 +261,7 @@ def test_streamed_join_aggregate(session):
     finally:
         SA.stream_scan_aggregate = orig
         session.conf.set("spark_tpu.sql.execution.streamingChunkRows", prev)
+        session.conf.set("spark_tpu.sql.io.deviceCacheBytes", prev_cache)
 
     m = fact.merge(dim, on="fk")
     want = (m.assign(gg=m["g"] % 7).groupby("gg")

@@ -1,0 +1,96 @@
+"""Device-table cache: loaded scans reused across queries, staleness by
+source identity stamps, LRU byte budget (round-4 perf work; reference:
+CacheManager.scala + the UnifiedMemoryManager storage pool)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+from spark_tpu.io.device_cache import CACHE
+
+
+@pytest.fixture(autouse=True)
+def clear_cache():
+    CACHE.clear()
+    yield
+    CACHE.clear()
+
+
+def test_parquet_scan_cached_across_queries(session, tmp_path):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": np.arange(1000, dtype=np.int64) % 7,
+                             "v": np.arange(1000, dtype=np.int64)}), p)
+    q = lambda: (session.read_parquet(p).group_by(col("k"))
+                 .agg(F.sum(col("v")).alias("s")).to_pandas()
+                 .sort_values("k").reset_index(drop=True))
+    first = q()
+    h0, m0 = CACHE.hits, CACHE.misses
+    second = q()
+    assert CACHE.hits > h0  # warm run hit the device cache
+    assert first.equals(second)
+
+
+def test_parquet_rewrite_invalidates(session, tmp_path):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"v": np.arange(10, dtype=np.int64)}), p)
+    s1 = session.read_parquet(p).agg(F.sum(col("v")).alias("s")) \
+        .to_pandas()["s"][0]
+    assert s1 == 45
+    pq.write_table(pa.table({"v": np.arange(100, dtype=np.int64)}), p)
+    s2 = session.read_parquet(p).agg(F.sum(col("v")).alias("s")) \
+        .to_pandas()["s"][0]
+    assert s2 == 4950  # (size, mtime) stamp changed -> cache miss
+
+
+def test_reregister_table_not_stale(session):
+    session.register_table("dc_t", pd.DataFrame(
+        {"v": np.array([1, 2, 3], dtype=np.int64)}))
+    a = session.table("dc_t").agg(F.sum(col("v")).alias("s")) \
+        .to_pandas()["s"][0]
+    session.register_table("dc_t", pd.DataFrame(
+        {"v": np.array([10, 20], dtype=np.int64)}))
+    b = session.table("dc_t").agg(F.sum(col("v")).alias("s")) \
+        .to_pandas()["s"][0]
+    assert (a, b) == (6, 30)  # fresh source token -> no stale hit
+
+
+def test_budget_eviction(session, tmp_path):
+    key_budget = "spark_tpu.sql.io.deviceCacheBytes"
+    prev = session.conf.get(key_budget)
+    try:
+        session.conf.set(key_budget, 64 << 10)  # 64 KB
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"t{i}.parquet")
+            pq.write_table(pa.table(
+                {"v": np.arange(4000, dtype=np.int64) + i}), p)
+            paths.append(p)
+        for p in paths:  # each table ~32KB: the third load evicts the first
+            session.read_parquet(p).agg(F.sum(col("v")).alias("s")) \
+                .to_pandas()
+        assert CACHE.nbytes <= 64 << 10
+        assert len(CACHE._entries) < 3
+    finally:
+        session.conf.set(key_budget, prev)
+
+
+def test_cache_disabled_matches(session, tmp_path):
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": np.arange(100, dtype=np.int64) % 3,
+                             "v": np.arange(100, dtype=np.int64)}), p)
+    key_budget = "spark_tpu.sql.io.deviceCacheBytes"
+    prev = session.conf.get(key_budget)
+    q = lambda: (session.read_parquet(p).group_by(col("k"))
+                 .agg(F.count().alias("c")).to_pandas()
+                 .sort_values("k").reset_index(drop=True))
+    warm = q()
+    try:
+        session.conf.set(key_budget, 0)
+        cold = q()
+    finally:
+        session.conf.set(key_budget, prev)
+    assert warm.equals(cold)

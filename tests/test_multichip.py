@@ -399,6 +399,9 @@ def test_distributed_streaming_aggregate(session):
     SA.stream_scan_aggregate_mesh = spy
     prev_chunk = session.conf.get("spark_tpu.sql.execution.streamingChunkRows")
     session.conf.set("spark_tpu.sql.execution.streamingChunkRows", 1024)
+    # disable the device cache so the (tiny) scan doesn't go resident
+    prev_cache = session.conf.get("spark_tpu.sql.io.deviceCacheBytes")
+    session.conf.set("spark_tpu.sql.io.deviceCacheBytes", 0)
     try:
         def build():
             return (session.table("stream_t")
@@ -410,4 +413,5 @@ def test_distributed_streaming_aggregate(session):
         SA.stream_scan_aggregate_mesh = orig
         session.conf.set("spark_tpu.sql.execution.streamingChunkRows",
                          prev_chunk)
+        session.conf.set("spark_tpu.sql.io.deviceCacheBytes", prev_cache)
     assert any(calls), "mesh streaming path never engaged"
