@@ -42,16 +42,29 @@ def bucket_capacity(n: int, growth: float = 2.0, floor: int = 8) -> int:
 
 
 class Column:
-    """One device column: data + optional validity + optional host dictionary."""
+    """One device column: data + optional validity + optional host dictionary.
 
-    __slots__ = ("data", "validity", "dtype", "dictionary")
+    `prov` (provenance) is a trace-time-only hint set by gathering
+    operators (joins): ``(base_data, base_validity, idx, present)`` with
+    the invariant ``data == take(base_data, idx)`` and ``validity ==
+    (take(base_validity, idx) &) present``. A downstream gather composes
+    indices (``base[idx[p]]``) instead of gathering the materialized
+    data (``(base[idx])[p]``), so in a chain of joins each payload
+    column is gathered ONCE from its origin and XLA dead-code-eliminates
+    the intermediate per-column gathers — the columnar late-
+    materialization the reference gets from row-at-a-time pipelining.
+    prov is NOT part of the pytree, so it never crosses a jit boundary
+    (dropping it is always sound: `data` stays eagerly defined)."""
+
+    __slots__ = ("data", "validity", "dtype", "dictionary", "prov")
 
     def __init__(self, data, dtype: T.DataType, validity=None,
-                 dictionary: Optional[pa.Array] = None):
+                 dictionary: Optional[pa.Array] = None, prov=None):
         self.data = data
         self.dtype = dtype
         self.validity = validity  # None means all-valid
         self.dictionary = dictionary  # host pyarrow array for StringType
+        self.prov = prov
 
     @property
     def capacity(self) -> int:

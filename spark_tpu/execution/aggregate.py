@@ -76,6 +76,43 @@ _SEGMENT_REDUCE = {
 }
 
 
+def _sorted_segment_reduce(contrib_sorted, reduce: str, starts_rows,
+                           start_pos, end_pos, present):
+    """Per-segment reduce over rows SORTED by segment, without a
+    colliding scatter (XLA scatter-add into shared slots serializes on
+    TPU: ~300ms for 4M rows into 65k segments, measured on Q3).
+
+    integer sum: prefix-sum difference csum[end] - csum[start-1] — int64
+    wraps mod 2^64 so the difference is exact. float sum and min/max: a
+    SEGMENTED associative scan (reset at `starts_rows` markers) read at
+    segment ends — a global-prefix difference would put each segment's
+    float error at the ulp of the whole-table running total instead of
+    the segment's own magnitude. start_pos/end_pos index each segment's
+    first/last sorted row; `present` masks empty segments."""
+    is_int = jnp.issubdtype(contrib_sorted.dtype, jnp.integer)
+    if reduce == "sum" and is_int:
+        csum = jnp.cumsum(contrib_sorted)
+        ex = csum - contrib_sorted  # exclusive prefix
+        out = jnp.take(csum, end_pos) - jnp.take(ex, start_pos)
+        return jnp.where(present, out, jnp.zeros_like(out))
+    if reduce == "sum":
+        op = jnp.add
+    else:
+        op = jnp.minimum if reduce == "min" else jnp.maximum
+
+    def combine(a, b):
+        va, fa = a
+        vb, fb = b
+        return (jnp.where(fb, vb, op(va, vb)), fa | fb)
+
+    scanned, _ = jax.lax.associative_scan(
+        combine, (contrib_sorted, starts_rows))
+    out = jnp.take(scanned, end_pos)
+    if reduce == "sum":
+        out = jnp.where(present, out, jnp.zeros_like(out))
+    return out
+
+
 def key_spans(nullables: Sequence[bool],
               domains: Sequence[Tuple[int, int]]) -> List[int]:
     """Per-key slot count: the value domain plus one NULL slot for
@@ -281,16 +318,33 @@ def sort_aggregate(key_vecs: Sequence[Vec],
     gid = jnp.where(valid_sorted & (gid < num_segments), gid,
                     num_segments)  # OOB -> dropped (flagged by caller)
 
-    occupied_cnt = jnp.zeros((num_segments,), jnp.int32).at[gid].add(
-        jnp.ones_like(gid), mode="drop")
+    # per-segment first/last sorted-row positions via NON-colliding
+    # scatters (each segment writes each exactly once); every reduce
+    # below reads prefix scans at these bounds — colliding scatter-adds
+    # serialize on TPU (~300ms for 4M rows into 65k segments)
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    in_seg = gid < num_segments
+    sidx = jnp.where(starts & in_seg, gid, num_segments)
+    nxt_gid = jnp.concatenate(
+        [gid[1:], jnp.full((1,), num_segments, gid.dtype)])
+    ends = in_seg & (nxt_gid != gid)
+    eidx = jnp.where(ends, gid, num_segments)
+    start_pos = jnp.zeros((num_segments,), jnp.int32).at[sidx].set(
+        pos, mode="drop")
+    end_pos = jnp.zeros((num_segments,), jnp.int32).at[eidx].set(
+        pos, mode="drop")
+    present = jnp.zeros((num_segments,), jnp.bool_).at[sidx].set(
+        jnp.ones((capacity,), jnp.bool_), mode="drop")
+    occupied_cnt = jnp.where(present, end_pos - start_pos + 1, 0)
 
     accs = []
     for row_contribs, row_specs in zip(contribs, specs):
         fn_accs = []
         for contrib, spec in zip(row_contribs, row_specs):
             contrib_sorted = jnp.take(contrib, perm)
-            red = _SEGMENT_REDUCE[spec.reduce]
-            out = red(contrib_sorted, gid, num_segments=num_segments + 1)[:-1]
+            out = _sorted_segment_reduce(contrib_sorted, spec.reduce,
+                                         starts, start_pos, end_pos,
+                                         present)
             if spec.reduce != "sum":
                 neutral = jnp.full((num_segments,), spec.neutral)
                 out = jnp.where(occupied_cnt > 0, out, neutral)

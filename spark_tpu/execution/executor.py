@@ -289,15 +289,8 @@ class QueryExecution:
     def _aqe_cache_key(self, mesh) -> Optional[str]:
         """Plan + data-identity key for persisted AQE capacities; None
         (uncacheable) when any scan's source has no identity stamp."""
-        tokens = []
-
-        def walk(node):
-            if isinstance(node, L.Scan):
-                tokens.append(node.source.cache_token())
-            for c in node.children:
-                walk(c)
-
-        walk(self.optimized_plan)
+        tokens = [s.source.cache_token()
+                  for s in L.iter_scans(self.optimized_plan)]
         if any(t is None for t in tokens):
             return None
         n = int(mesh.devices.size) if mesh is not None else 1
@@ -314,8 +307,11 @@ class QueryExecution:
         between one compile and a compile per retry per execution)."""
         for c in root.children:
             QueryExecution._collect_caps(c, out)
-        if isinstance(root, P.JoinExec) and root.out_cap is not None:
-            out[f"join:{root.tag}"] = root.out_cap
+        if isinstance(root, P.JoinExec):
+            if root.out_cap is not None:
+                out[f"join:{root.tag}"] = root.out_cap
+            if root.unique_build is False:
+                out[f"uniq:{root.tag}"] = 0
         elif isinstance(root, P.ExchangeExec) and root.block_cap is not None:
             out[f"exch:{root.tag}"] = root.block_cap
         elif isinstance(root, P.HashAggregateExec) and root.est_groups:
@@ -327,6 +323,8 @@ class QueryExecution:
             kind, tag = key.split(":", 1)
             if kind == "join":
                 self._set_join_cap(root, tag, cap)
+            elif kind == "uniq":
+                self._set_join_nonunique(root, tag)
             elif kind == "exch":
                 self._set_exchange_cap(root, tag, cap)
             else:
@@ -338,6 +336,13 @@ class QueryExecution:
             QueryExecution._set_join_cap(c, tag, cap)
         if isinstance(root, P.JoinExec) and root.tag == tag:
             root.out_cap = cap
+
+    @staticmethod
+    def _set_join_nonunique(root: P.PhysicalPlan, tag: str) -> None:
+        for c in root.children:
+            QueryExecution._set_join_nonunique(c, tag)
+        if isinstance(root, P.JoinExec) and root.tag == tag:
+            root.unique_build = False
 
     @staticmethod
     def _set_exchange_cap(root: P.PhysicalPlan, tag: str, cap: int) -> None:
@@ -364,6 +369,19 @@ class QueryExecution:
         from ..columnar import bucket_capacity
         from ..parallel.mesh import get_mesh
         self._activate_conf()
+        self.session._exec_depth += 1
+        try:
+            return self._execute_batch_inner()
+        finally:
+            self.session._exec_depth -= 1
+            if self.session._exec_depth == 0:
+                # implicit (WITH-clause) materializations are statement
+                # -scoped: evict when the outermost execution finishes
+                self.session._evict_implicit_caches()
+
+    def _execute_batch_inner(self) -> Tuple[Batch, Dict, Dict]:
+        from ..columnar import bucket_capacity
+        from ..parallel.mesh import get_mesh
         mesh = get_mesh(self.session.conf)
         # seed capacities a previous execution of this plan discovered,
         # so repeated queries skip the overflow->re-jit ramp entirely.
@@ -417,18 +435,26 @@ class QueryExecution:
                 flags, metrics = jax.device_get((flags, metrics))
                 overflow = [k for k, v in flags.items()
                             if k.startswith(("join_overflow_",
+                                             "join_nonunique_",
                                              "exch_overflow_",
                                              "agg_overflow_"))
                             and bool(v)]
                 if not overflow:
                     break
-                if not adaptive:
+                # unique-build fallback is a correctness re-plan, not a
+                # capacity growth — never gated by the adaptive conf
+                if not adaptive and any(
+                        not k.startswith("join_nonunique_")
+                        for k in overflow):
                     raise RuntimeError(
                         f"capacity overflow in {overflow} with adaptive "
                         f"re-planning disabled "
                         f"(spark_tpu.sql.adaptive.enabled=false)")
                 for k in overflow:
-                    if k.startswith("join_overflow_"):
+                    if k.startswith("join_nonunique_"):
+                        self._set_join_nonunique(
+                            root, k[len("join_nonunique_"):])
+                    elif k.startswith("join_overflow_"):
                         tag = k[len("join_overflow_"):]
                         total = int(metrics[f"join_rows_{tag}"])
                         self._set_join_cap(root, tag,

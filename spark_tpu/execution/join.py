@@ -58,13 +58,48 @@ def build_sorted(key: Vec, sel) -> Tuple:
     return keys_s, perm, n_valid, valid_s
 
 
+def build_has_duplicates(sorted_keys, valid_sorted):
+    """Traced bool: any two valid build rows share a key (adjacent
+    check on the sorted keys). Drives the unique-build fast path's
+    AQE fallback flag — a table-level property, conservatively True if
+    ANY key repeats (even unmatched ones)."""
+    same = sorted_keys[1:] == sorted_keys[:-1]
+    both = valid_sorted[1:] & valid_sorted[:-1]
+    return jnp.any(same & both)
+
+
+def match_unique(sorted_keys, n_valid, perm, probe_key: Vec, probe_sel):
+    """Unique-build match: each probe row matches at most one build row
+    (the FK->PK shape; reference: HashedRelation.scala keyIsUnique).
+    ONE searchsorted + one build-sized gather; no expansion, no
+    reindexing — probe columns pass through untouched.
+
+    Returns (build_idx, found)."""
+    lo = jnp.searchsorted(sorted_keys, probe_key.data, side="left",
+                          method="sort")
+    lo = jnp.minimum(lo, sorted_keys.shape[0] - 1).astype(jnp.int32)
+    found = (jnp.take(sorted_keys, lo) == probe_key.data) & (lo < n_valid)
+    if probe_key.validity is not None:
+        found = found & probe_key.validity
+    if probe_sel is not None:
+        found = found & probe_sel
+    build_idx = jnp.take(perm, lo)
+    return build_idx, found
+
+
 def match_ranges(sorted_keys, n_valid, probe_key: Vec, probe_sel):
     """Binary-search each probe key's build match range.
 
     Returns (lo, cnt): build rows [lo, lo+cnt) in sorted order match.
-    cnt is 0 for unmatched/invalid/unselected probe rows."""
-    lo = jnp.searchsorted(sorted_keys, probe_key.data, side="left")
-    hi = jnp.searchsorted(sorted_keys, probe_key.data, side="right")
+    cnt is 0 for unmatched/invalid/unselected probe rows.
+
+    method='sort' matters on TPU: the default 'scan' binary search is
+    log2(build) SEQUENTIAL whole-probe gathers (~1.4s for 8M probes,
+    measured), while one extra lax.sort is ~100ms."""
+    lo = jnp.searchsorted(sorted_keys, probe_key.data, side="left",
+                          method="sort")
+    hi = jnp.searchsorted(sorted_keys, probe_key.data, side="right",
+                          method="sort")
     lo = jnp.minimum(lo, n_valid).astype(jnp.int32)
     hi = jnp.minimum(hi, n_valid).astype(jnp.int32)
     found = hi > lo
@@ -92,14 +127,34 @@ def expand(lo, cnt_key, cnt_eff, perm, out_cap: int):
                   against out_cap and re-jits on overflow)
     """
     cap = cnt_eff.shape[0]
+    assert cap < (1 << 30) and perm.shape[0] < (1 << 30), \
+        "expand packs (probe idx, lo) into one int64"
     off = jnp.cumsum(cnt_eff) - cnt_eff  # exclusive prefix sum
     total = off[-1] + cnt_eff[-1]
     r = jnp.arange(out_cap, dtype=jnp.int32)
-    p = jnp.searchsorted(off, r, side="right").astype(jnp.int32) - 1
-    p = jnp.clip(p, 0, cap - 1)
-    j = r - jnp.take(off, p)
-    is_pair = j < jnp.take(cnt_key, p)
-    build_pos = jnp.clip(jnp.take(lo, p) + j, 0, perm.shape[0] - 1)
+    # Each emitting probe row owns a contiguous run of output rows
+    # starting at off[p]; probe indices increase across runs. Pack
+    # (probe idx, lo, cnt_key==0) into one int64, scatter it at each
+    # run start (non-colliding) and forward-fill with a running max —
+    # gathers (take(off/lo/cnt_key, p)) are ~10x slower than scans on
+    # TPU and dominated the round-3 join profile (~1.7s of Q5).
+    emitting = cnt_eff > 0
+    pidx = jnp.arange(cap, dtype=jnp.int64)
+    zflag = (cnt_key == 0).astype(jnp.int64)
+    pack = (pidx << 32) | (lo.astype(jnp.int64) << 1) | zflag
+    tgt = jnp.where(emitting, off, out_cap)
+    packs = jnp.zeros((out_cap,), jnp.int64).at[tgt].set(pack, mode="drop")
+    offm = jnp.zeros((out_cap,), jnp.int32).at[tgt].set(
+        off.astype(jnp.int32), mode="drop")
+    fill = jax.lax.cummax(packs)
+    off_run = jax.lax.cummax(offm)  # start position of r's run
+    p = (fill >> 32).astype(jnp.int32)
+    lo_p = ((fill >> 1) & jnp.int64(0x3FFFFFFF)).astype(jnp.int32)
+    j = r - off_run
+    # j < cnt_key[p] <=> the run emits pairs (cnt_eff==cnt_key) and not
+    # the cnt_key==0 null-extension run (cnt_eff=1, one row with j=0)
+    is_pair = (fill & 1) == 0
+    build_pos = jnp.clip(lo_p + j, 0, perm.shape[0] - 1)
     build_idx = jnp.take(perm, build_pos)
     valid = r < total
     return p, build_idx, is_pair & valid, valid, total
@@ -109,15 +164,39 @@ def gather_columns(batch: Batch, idx, present,
                    name_map: Sequence[Tuple[str, str]]
                    ) -> List[Tuple[str, Column]]:
     """Gather columns at idx; validity &= present (rows where the side
-    contributes no value — null-extensions — become NULL)."""
+    contributes no value — null-extensions — become NULL).
+
+    Columns carrying provenance compose indices (``base[idx0[idx]]``)
+    instead of gathering already-gathered data: the index composition is
+    ONE gather shared by every column from the same origin (XLA CSE),
+    and the upstream per-column gathers die by DCE unless something else
+    consumes them. This is what makes a chain of N joins cost one
+    payload gather per column instead of N (Q5's profile was dominated
+    by per-join payload gathers)."""
     out = []
     for src_name, out_name in name_map:
         col = batch.columns[src_name]
+        if col.prov is not None:
+            base_data, base_valid, idx0, present0 = col.prov
+            idx2 = jnp.take(idx0, idx)
+            data = jnp.take(base_data, idx2)
+            new_present = present if present0 is None else \
+                (jnp.take(present0, idx) & present)
+            if base_valid is not None:
+                validity = jnp.take(base_valid, idx2) & new_present
+            else:
+                validity = new_present
+            out.append((out_name, Column(
+                data, col.dtype, validity, col.dictionary,
+                prov=(base_data, base_valid, idx2, new_present))))
+            continue
         data = jnp.take(col.data, idx)
         if col.validity is not None:
             validity = jnp.take(col.validity, idx) & present
         else:
             validity = present
         out.append((out_name, Column(data, col.dtype, validity,
-                                     col.dictionary)))
+                                     col.dictionary,
+                                     prov=(col.data, col.validity, idx,
+                                           present))))
     return out

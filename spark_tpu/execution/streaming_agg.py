@@ -111,17 +111,21 @@ def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
         batch, flags, metrics = jax.jit(run)(inputs)
         flags, metrics = jax.device_get((flags, metrics))
         overflow = [k for k, v in flags.items()
-                    if k.startswith(("join_overflow_", "exch_overflow_",
-                                     "agg_overflow_"))
+                    if k.startswith(("join_overflow_", "join_nonunique_",
+                                     "exch_overflow_", "agg_overflow_"))
                     and bool(v)]
         if not overflow:
             return batch
-        if not adaptive:
+        if not adaptive and any(not k.startswith("join_nonunique_")
+                                for k in overflow):
             raise RuntimeError(
                 f"build-side capacity overflow in {overflow} with "
                 f"adaptive re-planning disabled")
         for k in overflow:
-            if k.startswith("join_overflow_"):
+            if k.startswith("join_nonunique_"):
+                QueryExecution._set_join_nonunique(
+                    root, k[len("join_nonunique_"):])
+            elif k.startswith("join_overflow_"):
                 tag = k[len("join_overflow_"):]
                 total = int(metrics[f"join_rows_{tag}"])
                 QueryExecution._set_join_cap(
@@ -268,11 +272,18 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
             new, flags, metrics = update_fn(tables, b, builds)
             flags, metrics = jax.device_get((flags, metrics))
             overflow = [k for k, v in flags.items()
-                        if k.startswith("join_overflow_")
+                        if k.startswith(("join_overflow_",
+                                         "join_nonunique_"))
                         and bool(v)]
             if not overflow:
                 return new
             for k in overflow:
+                if k.startswith("join_nonunique_"):
+                    tag = k[len("join_nonunique_"):]
+                    for j in joins:
+                        if j.tag == tag:
+                            j.unique_build = False
+                    continue
                 tag = k[len("join_overflow_"):]
                 total = int(metrics[f"join_rows_{tag}"])
                 for j in joins:

@@ -145,6 +145,16 @@ def iter_expressions(plan: LogicalPlan):
                 yield from w.children
 
 
+def iter_scans(plan: LogicalPlan):
+    """Yield every Scan node (shared by the data-cache fingerprint, the
+    AQE-caps key, and register_table invalidation — ONE walk to keep in
+    sync, per round-4 review)."""
+    if isinstance(plan, Scan):
+        yield plan
+    for c in plan.children:
+        yield from iter_scans(c)
+
+
 def map_expressions(plan: LogicalPlan, f) -> LogicalPlan:
     """Rebuild a plan with every embedded expression passed through
     `f: Expression -> Expression` (used for scalar-subquery substitution;
@@ -163,7 +173,8 @@ def map_expressions(plan: LogicalPlan, f) -> LogicalPlan:
                         [f(k) for k in node.left_keys],
                         [f(k) for k in node.right_keys], node.how,
                         None if node.condition is None
-                        else f(node.condition))
+                        else f(node.condition),
+                        node.null_aware)
         if isinstance(node, Aggregate):
             aggs = []
             for a in node.agg_exprs:
@@ -309,16 +320,24 @@ class Join(LogicalPlan):
 
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
-                 how: str = "inner", condition: Optional[Expression] = None):
+                 how: str = "inner", condition: Optional[Expression] = None,
+                 null_aware: bool = False):
         if how not in JOIN_TYPES:
             raise AnalysisError(f"unsupported join type {how!r}")
         if len(left_keys) != len(right_keys) or not left_keys:
             raise AnalysisError("join requires matching, non-empty key lists")
+        if null_aware and how != "left_anti":
+            raise AnalysisError("null_aware applies to left_anti only")
         self.children = (left, right)
         self.left_keys = tuple(left_keys)
         self.right_keys = tuple(right_keys)
         self.how = how
         self.condition = condition
+        # SQL NOT IN semantics (null-aware anti-join, reference: the
+        # NAAJ path in SparkStrategies JoinSelection): any NULL in the
+        # build keys empties the result; a NULL probe key only survives
+        # when the build side is empty
+        self.null_aware = null_aware
 
     @property
     def left(self):
@@ -359,6 +378,7 @@ class Join(LogicalPlan):
         return (f"Join({self.how}, {list(self.left_keys)!r} = "
                 f"{list(self.right_keys)!r}"
                 + (f", cond={self.condition!r}" if self.condition is not None else "")
+                + (", null_aware" if self.null_aware else "")
                 + ")")
 
 

@@ -200,7 +200,8 @@ class PruneColumns(Rule):
 
 
 def copy_join(j: Join, left, right) -> Join:
-    return Join(left, right, j.left_keys, j.right_keys, j.how, j.condition)
+    return Join(left, right, j.left_keys, j.right_keys, j.how, j.condition,
+                j.null_aware)
 
 
 _EMPTY_BATCH = None

@@ -910,6 +910,15 @@ class JoinExec(PhysicalPlan):
         self.condition = condition
         self._schema = out_schema
         self.out_cap = out_cap
+        # unique-build fast path (HashedRelation.scala keyIsUnique
+        # analog): assume each probe row matches <=1 build row — the
+        # FK->PK shape — and emit probe-layout output with zero
+        # expansion. A duplicate build key raises the
+        # join_nonunique_<tag> flag and the AQE loop re-jits with the
+        # general expansion path (False). None/True = try it.
+        self.unique_build: Optional[bool] = None
+        # SQL NOT IN null-aware anti-join (left_anti only)
+        self.null_aware = False
         self.tag = tag
         # "shuffle": co-partition both sides (ShuffledHashJoinExec.scala:37
         # analog); "broadcast": replicate the small build side via
@@ -997,11 +1006,116 @@ class JoinExec(PhysicalPlan):
             exact = True
         return lvecs, rvecs, lk, rk, exact
 
+    def _build_name_map(self, probe_batch, build_batch):
+        """(left_names, out_names) with the `_r` collision suffix shared
+        by every join type so one condition expression works for all."""
+        left_names = list(probe_batch.columns.keys())
+        if self.how in ("left_semi", "left_anti"):
+            taken = set(left_names)
+            out_names = list(left_names)
+            for n in build_batch.columns.keys():
+                name = n
+                while name in taken:
+                    name = name + "_r"
+                out_names.append(name)
+                taken.add(name)
+        else:
+            out_names = self._schema.names
+        return left_names, out_names
+
+    def _compute_unique(self, ctx, probe_batch, build_batch,
+                        lvecs, rvecs, lk, keys_s, perm, n_valid, valid_s):
+        """Unique-build fast path: probe-layout output, zero expansion
+        (HashedRelation keyIsUnique analog). Raises join_nonunique_<tag>
+        when the build side has duplicate keys; the AQE loop then
+        re-jits with unique_build=False."""
+        ctx.add_flag(f"join_nonunique_{self.tag}",
+                     join_kernels.build_has_duplicates(keys_s, valid_s))
+        build_idx, found = join_kernels.match_unique(
+            keys_s, n_valid, perm, lk, probe_batch.selection)
+        psel = probe_batch.selection_mask()
+        exact = len(lvecs) == 1
+        if not exact:
+            # packed keys: verify true equality (a pack collision pair
+            # in the build would have raised the nonunique flag, so the
+            # single candidate is the only possible match)
+            for lvec, rvec in zip(lvecs, rvecs):
+                eq = lvec.data == jnp.take(rvec.data, build_idx)
+                if lvec.validity is not None:
+                    eq = eq & lvec.validity
+                if rvec.validity is not None:
+                    eq = eq & jnp.take(rvec.validity, build_idx)
+                found = found & eq
+
+        left_names, out_names = self._build_name_map(probe_batch,
+                                                     build_batch)
+        n_left = len(left_names)
+        cols: Dict[str, Column] = {}
+        for name, out_name in zip(left_names, out_names[:n_left]):
+            cols[out_name] = probe_batch.columns[name]  # no gather
+        build_name_map = list(zip(build_batch.columns.keys(),
+                                  out_names[n_left:]))
+        for (out_name, col) in join_kernels.gather_columns(
+                build_batch, build_idx, found, build_name_map):
+            cols[out_name] = col
+
+        if self.condition is not None:
+            out_probe = Batch(cols, psel & found)
+            v = self.condition.eval(out_probe)
+            keep = v.data if v.validity is None else (v.data & v.validity)
+            found = found & keep
+            for out_name, col in join_kernels.gather_columns(
+                    build_batch, build_idx, found, build_name_map):
+                cols[out_name] = col
+
+        ctx.add_metric(f"join_rows_{self.tag}",
+                       jnp.sum((psel & found).astype(jnp.int64)))
+        if self.how == "left_semi":
+            return probe_batch.with_selection(psel & found)
+        if self.how == "left_anti":
+            sel = psel & ~found
+            if self.null_aware:
+                sel = sel & self._null_aware_mask(ctx, lvecs[0],
+                                                  build_batch, rvecs[0])
+            return probe_batch.with_selection(sel)
+        if self.how == "left":
+            return Batch(cols, psel)
+        return Batch(cols, psel & found)
+
+    def _null_aware_mask(self, ctx, probe_key_vec, build_batch,
+                         build_key_vec):
+        """Per-probe-row NOT IN adjustment (SQL three-valued logic):
+        a NULL anywhere in the build keys empties the result; a NULL
+        probe key survives only when the build side is empty. Scalars
+        reduce over the mesh axis — NULL build rows hash to ONE shard
+        but empty every shard's output."""
+        bsel = build_batch.selection_mask()
+        if build_key_vec.validity is not None:
+            has_null = jnp.sum((bsel & ~build_key_vec.validity)
+                               .astype(jnp.int32))
+        else:
+            has_null = jnp.zeros((), jnp.int32)
+        nonempty = jnp.sum(bsel.astype(jnp.int32))
+        if ctx.axis_name is not None:
+            has_null = jax.lax.psum(has_null, ctx.axis_name)
+            nonempty = jax.lax.psum(nonempty, ctx.axis_name)
+        mask = jnp.broadcast_to(has_null == 0,
+                                (probe_key_vec.data.shape[0],))
+        if probe_key_vec.validity is not None:
+            mask = mask & (probe_key_vec.validity | (nonempty == 0))
+        return mask
+
     def compute(self, ctx, inputs):
         probe_batch, build_batch = inputs
         lvecs, rvecs, lk, rk, exact = self._eval_keys(probe_batch, build_batch)
         keys_s, perm, n_valid, _valid_s = join_kernels.build_sorted(
             rk, build_batch.selection)
+        if (self.unique_build is not False
+                and self.how in ("inner", "left", "left_semi",
+                                 "left_anti")):
+            return self._compute_unique(ctx, probe_batch, build_batch,
+                                        lvecs, rvecs, lk, keys_s, perm,
+                                        n_valid, _valid_s)
         lo, cnt = join_kernels.match_ranges(keys_s, n_valid, lk,
                                             probe_batch.selection)
         psel = probe_batch.selection_mask()
@@ -1011,7 +1125,11 @@ class JoinExec(PhysicalPlan):
             found = cnt > 0
             if self.how == "left_semi":
                 return probe_batch.with_selection(psel & found)
-            return probe_batch.with_selection(psel & ~found)
+            sel = psel & ~found
+            if self.null_aware:
+                sel = sel & self._null_aware_mask(ctx, lvecs[0],
+                                                  build_batch, rvecs[0])
+            return probe_batch.with_selection(sel)
 
         probe_cap = probe_batch.capacity
         build_cap = build_batch.capacity
@@ -1038,22 +1156,8 @@ class JoinExec(PhysicalPlan):
                 pair_pass = pair_pass & eq
 
         # assemble the expanded block: probe columns at p, build at build_idx
-        left_names = list(probe_batch.columns.keys())
-        if semi_anti:
-            # semi/anti output is probe-shaped; the pair block exists only
-            # so the residual condition can see build columns. Collisions
-            # use the same `_r` suffix convention as Join.right_name_map()
-            # so one condition expression works for every join type.
-            taken = set(left_names)
-            out_names = list(left_names)
-            for n in build_batch.columns.keys():
-                name = n
-                while name in taken:
-                    name = name + "_r"
-                out_names.append(name)
-                taken.add(name)
-        else:
-            out_names = self._schema.names
+        left_names, out_names = self._build_name_map(probe_batch,
+                                                     build_batch)
         n_left = len(left_names)
         cols: Dict[str, Column] = {}
         for (out_name, col) in join_kernels.gather_columns(
@@ -1076,15 +1180,40 @@ class JoinExec(PhysicalPlan):
                     build_batch, build_idx, pair_pass, build_name_map):
                 cols[out_name] = col
 
-        # per-probe-row "any pair survived" (drives null-extension + semi/anti)
-        scatter_p = jnp.where(valid & pair_pass, p, probe_cap)
-        any_pass = jnp.zeros((probe_cap,), jnp.bool_).at[scatter_p].max(
-            jnp.ones_like(pair_pass), mode="drop")
+        # per-probe-row "any pair survived" (drives null-extension +
+        # semi/anti). p is non-decreasing (output rows are emitted in
+        # probe order), so count survivors per p-run with a prefix-sum
+        # difference at run bounds — a colliding scatter-max serializes
+        # on TPU (~90ms/4M rows, Q3 profile)
+        m = (valid & pair_pass).astype(jnp.int32)
+        csum_m = jnp.cumsum(m)
+        ex_m = csum_m - m
+        rpos = jnp.arange(out_cap, dtype=jnp.int32)
+        run_start = (rpos == 0) | (p != jnp.roll(p, 1))
+        nxt_p = jnp.concatenate([p[1:], jnp.full((1,), probe_cap, p.dtype)])
+        run_end = nxt_p != p
+        # no `valid` mask: tail rows (r >= total) share the last emitting
+        # row's p (clipped), so they extend its run with m=0 — harmless —
+        # while masking would lose that run's end marker entirely
+        sidx_p = jnp.where(run_start, p, probe_cap)
+        eidx_p = jnp.where(run_end, p, probe_cap)
+        pstart = jnp.zeros((probe_cap,), jnp.int32).at[sidx_p].set(
+            rpos, mode="drop")
+        pend = jnp.zeros((probe_cap,), jnp.int32).at[eidx_p].set(
+            rpos, mode="drop")
+        ppresent = jnp.zeros((probe_cap,), jnp.bool_).at[sidx_p].set(
+            jnp.ones((out_cap,), jnp.bool_), mode="drop")
+        any_pass = ppresent & (
+            (jnp.take(csum_m, pend) - jnp.take(ex_m, pstart)) > 0)
 
         if semi_anti:
             if self.how == "left_semi":
                 return probe_batch.with_selection(psel & any_pass)
-            return probe_batch.with_selection(psel & ~any_pass)
+            sel = psel & ~any_pass
+            if self.null_aware:
+                sel = sel & self._null_aware_mask(ctx, lvecs[0],
+                                                  build_batch, rvecs[0])
+            return probe_batch.with_selection(sel)
 
         if outer_probe:
             # keep surviving pairs; for probe rows with none, keep exactly
@@ -1138,7 +1267,9 @@ class JoinExec(PhysicalPlan):
         return (f"JoinExec({self.how}, {[repr(k) for k in self.left_keys]} = "
                 f"{[repr(k) for k in self.right_keys]}, "
                 f"cond={self.condition!r}, cap={self.out_cap}, "
-                f"strategy={self.strategy})")
+                f"uniq={self.unique_build}, "
+                + ("null_aware, " if self.null_aware else "")
+                + f"strategy={self.strategy})")
 
 
 def _unify_key_dictionaries(lvecs: List[Vec], rvecs: List[Vec]

@@ -141,10 +141,12 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
                                    mode="final", est_groups=est)
     if isinstance(plan, L.Join):
         strategy = _pick_join_strategy(plan, conf, n)
-        return P.JoinExec(_convert(plan.left, conf, n),
-                          _convert(plan.right, conf, n),
-                          plan.left_keys, plan.right_keys, plan.how,
-                          plan.condition, plan.schema(), strategy=strategy)
+        exec_ = P.JoinExec(_convert(plan.left, conf, n),
+                           _convert(plan.right, conf, n),
+                           plan.left_keys, plan.right_keys, plan.how,
+                           plan.condition, plan.schema(), strategy=strategy)
+        exec_.null_aware = plan.null_aware
+        return exec_
     if isinstance(plan, L.WindowPlan):
         return P.WindowExec(_convert(plan.child, conf, n), plan.wexprs,
                             plan.schema())

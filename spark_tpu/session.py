@@ -30,6 +30,8 @@ class SparkTpuSession:
         # action; later plans substitute equal subtrees with cached scans
         self._cache_requests: Dict[str, object] = {}  # fp -> LogicalPlan
         self._data_cache: Dict[str, pa.Table] = {}
+        self._implicit_cache_fps: set = set()
+        self._exec_depth = 0  # outermost-execution tracking for eviction
         # plan-fingerprint -> {kind:tag -> capacity} discovered by the
         # AQE overflow loop; repeated executions seed these and skip the
         # overflow->re-jit ramp
@@ -40,15 +42,34 @@ class SparkTpuSession:
 
     @staticmethod
     def _plan_fingerprint(plan) -> str:
-        return plan.tree_string()
+        """tree_string + each scan source's identity stamp: a Parquet
+        rewrite or table re-registration changes the fingerprint, so a
+        cached materialization can never match stale data (round-3
+        ADVICE medium)."""
+        tokens = [s.source.cache_token() for s in L.iter_scans(plan)]
+        return plan.tree_string() + f"#src{tokens!r}"
 
-    def mark_cache(self, plan) -> None:
-        self._cache_requests[self._plan_fingerprint(plan)] = plan
+    def mark_cache(self, plan, implicit: bool = False) -> None:
+        fp = self._plan_fingerprint(plan)
+        self._cache_requests[fp] = plan
+        if implicit:
+            # statement-scoped (e.g. WITH-clause views): evicted when the
+            # outermost execution finishes, so implicit materializations
+            # neither go stale nor grow session memory unboundedly
+            self._implicit_cache_fps.add(fp)
 
     def uncache(self, plan) -> None:
         fp = self._plan_fingerprint(plan)
         self._cache_requests.pop(fp, None)
         self._data_cache.pop(fp, None)
+        self._implicit_cache_fps.discard(fp)
+
+    def _evict_implicit_caches(self) -> None:
+        """Statement-scoped DATA lifetime: drop materialized tables but
+        KEEP the requests/marks, so re-executing the same statement
+        still dedupes a multiply-referenced CTE within that execution."""
+        for fp in self._implicit_cache_fps:
+            self._data_cache.pop(fp, None)
 
     # -- builder ------------------------------------------------------------
 
@@ -94,6 +115,23 @@ class SparkTpuSession:
     createDataFrame = create_dataframe
 
     def register_table(self, name: str, source_or_table) -> None:
+        # invalidate cached materializations referencing this name (a
+        # re-registered table must never serve stale cached results)
+        stale = [fp for fp, plan in self._cache_requests.items()
+                 if any(s.source.name == name for s in L.iter_scans(plan))]
+        for fp in stale:
+            self._cache_requests.pop(fp, None)
+            self._data_cache.pop(fp, None)
+            self._implicit_cache_fps.discard(fp)
+        # free the replaced source's device-resident batches (they are
+        # unreachable under the new token and would pin HBM until LRU
+        # pressure evicted them)
+        old = self.catalog.get(name)
+        if old is not None:
+            token = old.cache_token()
+            if token is not None:
+                from .io.device_cache import CACHE
+                CACHE.invalidate_token(token)
         if isinstance(source_or_table, TableSource):
             self.catalog[name] = source_or_table
         elif isinstance(source_or_table, pa.Table):

@@ -1014,8 +1014,10 @@ class Lowerer:
             self._ctes[name] = plan
             # mark for the plan-fingerprint cache: a CTE referenced
             # more than once (Q15's FROM + scalar subquery) materializes
-            # once on first use instead of re-executing per reference
-            self.session.mark_cache(plan)
+            # once on first use instead of re-executing per reference.
+            # implicit=True scopes the entry to one statement execution
+            # (evicted afterwards — no staleness, no unbounded growth)
+            self.session.mark_cache(plan, implicit=True)
         if sel.union_of is not None:
             plan = L.Union(self.lower(sel.union_of[0]),
                            self.lower(sel.union_of[1]))
@@ -1476,15 +1478,20 @@ class Lowerer:
 
         if isinstance(e, _InSubquery):
             sub_plan = self.lower(e.select)
-            out_cols = sub_plan.schema().names
+            sub_schema = sub_plan.schema()
+            out_cols = sub_schema.names
             if len(out_cols) != 1:
                 raise AnalysisError(
                     "IN (subquery) requires exactly one output column")
             how = "left_anti" if negate else "left_semi"
-            # NOTE: NOT IN over a subquery producing NULLs deviates from
-            # SQL's null-aware anti-join (rows are kept, not dropped)
-            return L.Join(plan, sub_plan, [scope.rewrite(e.children[0])],
-                          [ColumnRef(out_cols[0])], how)
+            probe = scope.rewrite(e.children[0])
+            # NOT IN lowers to the NULL-AWARE anti-join (SQL three-valued
+            # logic: one NULL in the subquery output empties the result;
+            # a NULL probe survives only an empty subquery) — round-3
+            # ADVICE low; reference: the NAAJ path in JoinSelection
+            return L.Join(plan, sub_plan, [probe],
+                          [ColumnRef(out_cols[0])], how,
+                          null_aware=negate)
 
         if isinstance(e, _ExistsSubquery):
             if any(_contains_agg(ie) for ie, _a in (e.select.items or [])):

@@ -1,0 +1,98 @@
+"""Round-3 ADVICE fixes: NOT IN null-aware anti-join semantics and
+statement-scoped CTE caching with re-register invalidation."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _t(session, name, **cols):
+    session.register_table(name, pd.DataFrame(cols))
+
+
+def test_not_in_null_in_subquery_empties_result(session):
+    _t(session, "na_t", x=np.array([1, 2, 3], dtype=np.int64))
+    _t(session, "na_s", y=pd.array([1, None], dtype="Int64"))
+    out = session.sql(
+        "SELECT x FROM na_t WHERE x NOT IN (SELECT y FROM na_s)"
+    ).to_pandas()
+    assert len(out) == 0  # NULL in the subquery -> three-valued UNKNOWN
+
+
+def test_not_in_empty_subquery_keeps_all(session):
+    _t(session, "na_t2", x=pd.array([1, None, 3], dtype="Int64"))
+    _t(session, "na_s2", y=np.array([99], dtype=np.int64))
+    out = session.sql(
+        "SELECT x FROM na_t2 WHERE x NOT IN "
+        "(SELECT y FROM na_s2 WHERE y < 0)").to_pandas()
+    # empty subquery: NOT IN is TRUE for every row, even NULL x
+    assert len(out) == 3
+
+
+def test_not_in_null_probe_dropped(session):
+    _t(session, "na_t3", x=pd.array([1, None, 5], dtype="Int64"))
+    _t(session, "na_s3", y=np.array([1, 2], dtype=np.int64))
+    out = session.sql(
+        "SELECT x FROM na_t3 WHERE x NOT IN (SELECT y FROM na_s3)"
+    ).to_pandas()
+    assert out["x"].tolist() == [5]  # NULL probe is UNKNOWN, dropped
+
+
+def test_not_in_plain_still_works(session):
+    _t(session, "na_t4", x=np.array([1, 2, 3], dtype=np.int64))
+    _t(session, "na_s4", y=np.array([2], dtype=np.int64))
+    out = session.sql(
+        "SELECT x FROM na_t4 WHERE x NOT IN (SELECT y FROM na_s4)"
+    ).to_pandas()
+    assert sorted(out["x"].tolist()) == [1, 3]
+
+
+def test_not_in_null_aware_mesh_parity(session):
+    mesh_key = "spark_tpu.sql.mesh.size"
+    _t(session, "na_t5",
+       x=pd.array([1, None, 5, 7, 8], dtype="Int64"))
+    _t(session, "na_s5", y=np.array([1, 7], dtype=np.int64))
+    q = ("SELECT x FROM na_t5 WHERE x NOT IN (SELECT y FROM na_s5)")
+    want = sorted(session.sql(q).to_pandas()["x"].tolist())
+    try:
+        session.conf.set(mesh_key, 8)
+        got = sorted(session.sql(q).to_pandas()["x"].tolist())
+    finally:
+        session.conf.set(mesh_key, 0)
+    assert got == want == [5, 8]
+
+
+def test_not_in_null_aware_survives_scalar_subquery(session):
+    """Round-4 review: map_expressions (run when a scalar subquery is
+    present) rebuilt Joins without the null_aware flag, silently
+    reverting NOT IN to plain anti-join."""
+    _t(session, "na_t6", x=np.array([1, 2, 3], dtype=np.int64))
+    _t(session, "na_s6", y=pd.array([1, None], dtype="Int64"))
+    out = session.sql(
+        "SELECT x FROM na_t6 WHERE x > (SELECT min(y) FROM na_s6) "
+        "AND x NOT IN (SELECT y FROM na_s6)").to_pandas()
+    assert len(out) == 0  # NULL in the NOT IN subquery: zero rows
+
+
+def test_reregister_invalidates_cte_cache(session):
+    """Round-3 ADVICE medium: the session plan-fingerprint cache kept
+    CTE materializations keyed only by table NAME; re-registering and
+    re-running a WITH query returned stale results."""
+    _t(session, "cc_t", v=np.array([1, 2, 3], dtype=np.int64))
+    q = ("WITH s AS (SELECT sum(v) AS sv FROM cc_t) "
+         "SELECT sv FROM s")
+    assert session.sql(q).to_pandas()["sv"][0] == 6
+    _t(session, "cc_t", v=np.array([10, 20], dtype=np.int64))
+    assert session.sql(q).to_pandas()["sv"][0] == 30
+
+
+def test_implicit_cte_data_evicted(session):
+    """WITH-clause materializations are statement-scoped: materialized
+    DATA does not accumulate in the session after execution (the
+    requests/marks stay so re-execution still dedupes)."""
+    _t(session, "ev_t", v=np.array([1, 2], dtype=np.int64))
+    q = ("WITH s AS (SELECT v + 1 AS w FROM ev_t) "
+         "SELECT sum(w) AS sw FROM s")
+    before_data = len(session._data_cache)
+    assert session.sql(q).to_pandas()["sw"][0] == 5
+    assert len(session._data_cache) == before_data
