@@ -56,15 +56,19 @@ class Column:
     prov is NOT part of the pytree, so it never crosses a jit boundary
     (dropping it is always sound: `data` stays eagerly defined)."""
 
-    __slots__ = ("data", "validity", "dtype", "dictionary", "prov")
+    __slots__ = ("data", "validity", "dtype", "dictionary", "prov", "bits")
 
     def __init__(self, data, dtype: T.DataType, validity=None,
-                 dictionary: Optional[pa.Array] = None, prov=None):
+                 dictionary: Optional[pa.Array] = None, prov=None,
+                 bits: Optional[int] = None):
         self.data = data
         self.dtype = dtype
         self.validity = validity  # None means all-valid
         self.dictionary = dictionary  # host pyarrow array for StringType
         self.prov = prov
+        # optional static value bound: values in [0, 2^bits) — lets
+        # int64 arithmetic take single-pass f64 fast paths (see Vec.bits)
+        self.bits = bits
 
     @property
     def capacity(self) -> int:

@@ -157,7 +157,8 @@ def direct_init(spans: Sequence[int], specs: List[List[AccSpec]]):
 
 def direct_update(tables, idx, total, contribs: List[List],
                   specs: List[List[AccSpec]], kernel_mode: str = "auto",
-                  merge: bool = False):
+                  merge: bool = False,
+                  reuse_count: Optional[Tuple[int, int]] = None):
     """Merge one chunk's contributions into carried tables (associative).
 
     kernel_mode: 'auto' uses the Pallas MXU one-hot matmul kernel on TPU
@@ -169,6 +170,13 @@ def direct_update(tables, idx, total, contribs: List[List],
     -mode aggregate folding per-shard tables), not raw per-row values:
     AccSpec.width bounds only the raw update, so merge forces full
     64-bit limbs — a partial count easily exceeds 2^8.
+
+    reuse_count=(i, j): the caller promises contribs[i][j] equals the
+    selection indicator (a count over a never-null child), so the
+    kernel's occupancy row rides that row's sums instead of adding its
+    own — the MXU kernel cost is linear in limb rows, and a count-only
+    aggregate (post RewriteGroupKeyAggregates) drops from 2 rows to 1.
+    Ignored in merge mode (partial counts are not indicators).
     """
     cnt, accs = tables
     if np.ndim(idx) == 0:
@@ -188,23 +196,38 @@ def direct_update(tables, idx, total, contribs: List[List],
     if all_sum and use_kernel and total <= (1 << 20) and \
             (idx.shape[0] >= 128 or kernel_mode == "matmul"):
         from .pallas_groupby import dense_groupby_sums
-        int_rows = [jnp.ones(idx.shape, jnp.int64)]
-        int_widths = [8]  # the occupancy count contributes 0/1
+        reuse = reuse_count if not merge else None
+        if reuse is not None:
+            int_rows = []
+            int_widths = []
+        else:
+            int_rows = [jnp.ones(idx.shape, jnp.int64)]
+            int_widths = [8]  # the occupancy count contributes 0/1
         float_rows = []
         layout = []  # (row_kind, index) per (i, j)
-        for contrib_row, spec_row in zip(contribs, specs):
-            for contrib, spec in zip(contrib_row, spec_row):
+        reuse_pos = None
+        for i, (contrib_row, spec_row) in enumerate(zip(contribs, specs)):
+            for j, (contrib, spec) in enumerate(zip(contrib_row, spec_row)):
                 if np.issubdtype(spec.np_dtype, np.floating):
                     layout.append(("f", len(float_rows)))
                     float_rows.append(contrib)
                 else:
                     layout.append(("i", len(int_rows)))
+                    if reuse == (i, j):
+                        reuse_pos = len(int_rows)
                     int_rows.append(contrib.astype(jnp.int64))
                     int_widths.append(64 if merge else spec.width)
+        if reuse is not None and reuse_pos is None:
+            # promised row turned out to be a float row: fall back
+            int_rows = [jnp.ones(idx.shape, jnp.int64)] + int_rows
+            int_widths = [8] + int_widths
+            layout = [(k, p + 1) if k == "i" else (k, p)
+                      for (k, p) in layout]
+            reuse_pos = 0
         int_sums, float_sums = dense_groupby_sums(
             idx, int_rows, float_rows, total,
             interpret=(backend != "tpu"), int_widths=int_widths)
-        cnt = cnt + int_sums[0]
+        cnt = cnt + int_sums[reuse_pos if reuse_pos is not None else 0]
         new_accs = []
         k = 0
         for table_row, spec_row in zip(accs, specs):
@@ -267,13 +290,16 @@ def direct_aggregate(key_vecs: Sequence[Vec],
                      spans: Sequence[int],
                      contribs: List[List], specs: List[List[AccSpec]],
                      sel, kernel_mode: str = "auto",
-                     merge: bool = False) -> Tuple[List, List, List, object]:
+                     merge: bool = False,
+                     reuse_count: Optional[Tuple[int, int]] = None
+                     ) -> Tuple[List, List, List, object]:
     """One-shot dense-domain aggregation.
     Returns (key_arrays, key_valids, acc_arrays, occupied)."""
     idx, total, strides = direct_index(key_vecs, domains, spans, sel)
     tables = direct_init(spans, specs)
     cnt, accs = direct_update(tables, idx, total, contribs, specs,
-                              kernel_mode=kernel_mode, merge=merge)
+                              kernel_mode=kernel_mode, merge=merge,
+                              reuse_count=reuse_count)
     key_arrays, key_valids = direct_keys(domains, spans, strides,
                                          [v.dtype for v in key_vecs])
     return key_arrays, key_valids, accs, cnt > 0

@@ -43,8 +43,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _I0 = np.int32(0)    # index-map constants must be int32 for Mosaic
-TILE = 1024          # rows per grid step
-SUPER = 64           # tiles per exact-f32 accumulation window (T*S*255 < 2^24)
+TILE = 8192          # rows per grid step (large: amortizes per-step
+                     # overhead — 1024-row tiles left the MXU at ~10%
+                     # on the 65k-domain shape, round-4 profiling)
+SUPER = 8            # tiles per exact-f32 accumulation window
 D_BLOCK = 512        # small-domain kernel: columns per block
 FACTOR_B = 512       # factorized kernel: dB (lane dimension)
 PARTIAL_BUDGET = 256 * 1024 * 1024  # max bytes of per-call partial sums
@@ -68,17 +70,23 @@ def _limb_layout(widths: Sequence[int]) -> List[Tuple[int, int, int]]:
 def _split_u32(int_rows: List, widths: Sequence[int], pad_rows) -> Tuple:
     """Stack the uint32 words the layout needs: all lo words, then hi
     words for rows wider than 32 bits. Returns (u32 [W, N], word_index
-    map {(row, half) -> u32 row})."""
+    map {(row, half) -> u32 row}).
+
+    Rows with width <= 32 skip the int64 round trip entirely (a direct
+    int32 truncation is exact for them): int64 is software-emulated on
+    TPU and these passes showed up at chunk scale in round-4 profiles."""
     words = []
     index = {}
     for k, r in enumerate(int_rows):
-        iv = pad_rows(r.astype(jnp.int64))
         index[(k, 0)] = len(words)
+        if widths[k] <= 32:
+            words.append(pad_rows(r).astype(jnp.int32))
+            continue
+        iv = pad_rows(r.astype(jnp.int64))
         words.append((iv & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
                      .view(jnp.int32))
-        if widths[k] > 32:
-            index[(k, 1)] = len(words)
-            words.append((iv >> 32).astype(jnp.int32))
+        index[(k, 1)] = len(words)
+        words.append((iv >> 32).astype(jnp.int32))
     return jnp.stack(words), index
 
 

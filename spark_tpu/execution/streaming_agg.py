@@ -149,7 +149,7 @@ def _range_chunk(leaf: P.RangeExec, start, chunk_rows: int,
     ids = leaf.start + leaf.step * (start + jnp.arange(chunk_rows,
                                                       dtype=jnp.int64))
     sel = (start + jnp.arange(chunk_rows, dtype=jnp.int64)) < rows_total
-    return Batch({"id": Column(ids, T.LONG)}, sel)
+    return Batch({"id": Column(ids, T.LONG, bits=leaf._id_bits())}, sel)
 
 
 def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,

@@ -33,12 +33,19 @@ from .columnar import Batch, Column
 
 @dataclass
 class Vec:
-    """An evaluated column-expression: data + validity + type + dictionary."""
+    """An evaluated column-expression: data + validity + type + dictionary.
+
+    `bits`: optional static bound — values are known to lie in
+    [0, 2^bits). Sources with known ranges (Range ids) set it so int64
+    arithmetic can take single-pass f64 fast paths (TPU emulates both
+    int64 and f64 in software; one emulated pass instead of three is
+    measurable at bench scales)."""
 
     data: Any
     dtype: T.DataType
     validity: Any = None  # None = all valid
     dictionary: Optional[pa.Array] = None
+    bits: Optional[int] = None
 
     def valid_mask(self):
         if self.validity is None:
@@ -203,7 +210,8 @@ class ColumnRef(Expression):
 
     def eval(self, batch: Batch) -> Vec:
         col = _resolve_column(batch, self._name)
-        return Vec(col.data, col.dtype, col.validity, col.dictionary)
+        return Vec(col.data, col.dtype, col.validity, col.dictionary,
+                   bits=getattr(col, "bits", None))
 
     def references(self) -> set:
         return {self._name}
@@ -600,7 +608,10 @@ class Mod(BinaryArithmetic):
                 return jnp.where(r < 0, r + m,
                                  jnp.where(r >= m, r - m, r))
 
-            if np.dtype(x.dtype).itemsize <= 4:
+            if np.dtype(x.dtype).itemsize <= 4 or \
+                    (lv.bits is not None and lv.bits <= 52):
+                # int64 with a static value bound < 2^52: one exact
+                # f64 pass instead of the three-mod halves ladder
                 r = f64_mod(x.astype(jnp.int64))
             else:
                 # int64: u32-half mods (f64-exact) + recombination < m^2 < 2^52

@@ -233,6 +233,7 @@ class RangeExec(LeafExec):
     def compute(self, ctx, inputs):
         n = self.num_rows()
         cap = bucket_capacity(n)
+        bits = self._id_bits()
         if ctx.axis_name is not None:
             # synthesize only this shard's contiguous stripe
             shards = ctx.n_shards
@@ -242,10 +243,21 @@ class RangeExec(LeafExec):
             base = i.astype(jnp.int64) * local
             offs = base + jnp.arange(local, dtype=jnp.int64)
             ids = self.start + self.step * offs
-            return Batch({"id": Column(ids, T.LONG)}, offs < n)
+            return Batch({"id": Column(ids, T.LONG, bits=bits)}, offs < n)
         ids = self.start + self.step * jnp.arange(cap, dtype=jnp.int64)
         sel = jnp.arange(cap) < n
-        return Batch({"id": Column(ids, T.LONG)}, sel)
+        return Batch({"id": Column(ids, T.LONG, bits=bits)}, sel)
+
+    def _id_bits(self) -> Optional[int]:
+        """Static id bound: values in [0, 2^bits) when the range is
+        non-negative. Capacity padding (bucket rounding, chunk tails,
+        and shard-multiple rounding) can synthesize ids past `end`;
+        2x num_rows plus a generous shard-rounding slack bounds every
+        padding scheme used."""
+        if self.start < 0 or self.step < 0:
+            return None
+        hi = self.start + self.step * (2 * max(self.num_rows(), 8) + 8192)
+        return max(1, int(np.ceil(np.log2(max(hi, 2)))))
 
     def simple_string(self):
         return f"RangeExec({self.start},{self.end},{self.step})"
@@ -476,7 +488,9 @@ class HashAggregateExec(UnaryExec):
                     key_vecs, domains, spans, contribs, specs, sel,
                     kernel_mode=str(ctx.conf.get(
                         "spark_tpu.sql.aggregate.kernelMode")),
-                    merge=(self.mode == "final"))
+                    merge=(self.mode == "final"),
+                    reuse_count=None if self.mode == "final"
+                    else self._occupancy_reuse(batch))
         else:
             num_segments = batch.capacity
             if self.est_groups and self.group_exprs:
@@ -517,6 +531,23 @@ class HashAggregateExec(UnaryExec):
                     data, a.func.result_type(base), validity)
         ctx.add_metric(f"agg_groups", jnp.sum(occupied.astype(jnp.int32)))
         return Batch(cols, occupied)
+
+    def _occupancy_reuse(self, batch) -> Optional[Tuple[int, int]]:
+        """(i, j) of an accumulator whose contribution equals the
+        selection indicator (a count over a trace-time-never-null
+        child): the MXU kernel rides it for occupancy instead of adding
+        its own ones row. Trace-time validity (`v.validity is None`) is
+        the exact gate — static nullability over-approximates (e.g.
+        `pmod(x, const)` is schema-nullable but runtime-valid)."""
+        from ..expr_agg import Avg, Count, Sum
+        for i, a in enumerate(self.agg_exprs):
+            f = a.func
+            if isinstance(f, Count) and f.child is None:
+                return (i, 0)
+            if isinstance(f, (Count, Sum, Avg)) and f.child is not None:
+                if f.child.eval(batch).validity is None:
+                    return (i, 0 if isinstance(f, Count) else 1)
+        return None
 
     # -- reusable direct-path steps (shared with the streaming driver) ------
 
@@ -566,7 +597,9 @@ class HashAggregateExec(UnaryExec):
         mode = str(conf.get("spark_tpu.sql.aggregate.kernelMode")) \
             if conf is not None else "auto"
         return agg_kernels.direct_update(tables, idx, prep.total, contribs,
-                                         prep.specs, kernel_mode=mode)
+                                         prep.specs, kernel_mode=mode,
+                                         reuse_count=self._occupancy_reuse(
+                                             batch))
 
     def direct_finalize_tables(self, tables, prep: "DirectAggPlan",
                                dict_overrides: Optional[Dict] = None) -> Batch:

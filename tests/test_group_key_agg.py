@@ -1,0 +1,69 @@
+"""RewriteGroupKeyAggregates: sum/min/max/avg of the group key computed
+post-aggregation (kernel limb-row reduction), with the alias-shadowing
+regression from the round-4 review."""
+
+import numpy as np
+import pandas as pd
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+
+
+def test_group_key_agg_rewrite_parity(session):
+    df = (session.range(10_000)
+          .select(F.pmod(col("id"), 37).alias("k"))
+          .group_by(col("k"))
+          .agg(F.sum(col("k")).alias("s"), F.min(col("k")).alias("mn"),
+               F.max(col("k")).alias("mx"), F.avg(col("k")).alias("a"),
+               F.count().alias("c")))
+    # rule engaged: the optimized plan aggregates only counts
+    opt = df._qe().optimized_plan.tree_string()
+    assert "__gk_cnt" in opt
+    out = df.to_pandas().sort_values("k").reset_index(drop=True)
+    pdf = pd.DataFrame({"k": np.arange(10_000) % 37})
+    want = (pdf.groupby("k")["k"]
+            .agg(["sum", "min", "max", "mean", "size"]).reset_index())
+    assert out["s"].tolist() == want["sum"].tolist()
+    assert out["mn"].tolist() == want["min"].tolist()
+    assert out["mx"].tolist() == want["max"].tolist()
+    assert np.allclose(out["a"], want["mean"])
+    assert out["c"].tolist() == want["size"].tolist()
+
+
+def test_group_key_agg_null_keys(session):
+    t = pd.DataFrame({"k": pd.array([1, 1, None, 2], dtype="Int64")})
+    o = (session.create_dataframe(t).group_by(col("k"))
+         .agg(F.sum(col("k")).alias("s"), F.max(col("k")).alias("m"))
+         .to_pandas().sort_values("k", na_position="first")
+         .reset_index(drop=True))
+    assert pd.isna(o["s"][0]) and pd.isna(o["m"][0])
+    assert o["s"].tolist()[1:] == [2, 2]
+    assert o["m"].tolist()[1:] == [1, 2]
+
+
+def test_alias_shadowing_real_column_not_rewritten(session):
+    """Round-4 review bug: group alias named like a REAL child column
+    must not capture aggregates over that column."""
+    pdf = pd.DataFrame({"a": np.array([1, 1, 2], dtype=np.int64),
+                        "k": np.array([100, 100, 7], dtype=np.int64)})
+    df = session.create_dataframe(pdf)
+    out = (df.group_by(col("a").alias("k"))
+           .agg(F.sum(col("k")).alias("s"), F.min(col("k")).alias("mn"))
+           .to_pandas().sort_values("k").reset_index(drop=True))
+    assert out["s"].tolist() == [200, 7]
+    assert out["mn"].tolist() == [100, 7]
+
+
+def test_group_key_agg_mesh_parity(session):
+    mesh_key = "spark_tpu.sql.mesh.size"
+    build = lambda: (session.range(5_000)
+                     .group_by((col("id") % 11).alias("k"))
+                     .agg(F.sum(col("k")).alias("s"),
+                          F.count().alias("c")))
+    want = build().to_pandas().sort_values("k").reset_index(drop=True)
+    try:
+        session.conf.set(mesh_key, 8)
+        got = build().to_pandas().sort_values("k").reset_index(drop=True)
+    finally:
+        session.conf.set(mesh_key, 0)
+    assert got.equals(want)
