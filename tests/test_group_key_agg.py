@@ -57,7 +57,8 @@ def test_alias_shadowing_real_column_not_rewritten(session):
 def test_group_key_agg_mesh_parity(session):
     mesh_key = "spark_tpu.sql.mesh.size"
     build = lambda: (session.range(5_000)
-                     .group_by((col("id") % 11).alias("k"))
+                     .select((col("id") % 11).alias("k"))
+                     .group_by(col("k"))
                      .agg(F.sum(col("k")).alias("s"),
                           F.count().alias("c")))
     want = build().to_pandas().sort_values("k").reset_index(drop=True)
