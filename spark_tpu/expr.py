@@ -1367,8 +1367,52 @@ class CaseWhen(Expression):
             out = T.common_type(out, d)
         return out
 
+    def _eval_string(self, batch):
+        """CASE producing strings from LITERAL branches: the branch
+        values become the dictionary and codes select by condition
+        (string columns in branches would need dictionary unification —
+        unsupported)."""
+        import pyarrow as pa
+        vals = []
+        for _c, v in self.branches:
+            if not (isinstance(v, Literal)
+                    and (v.value is None or isinstance(v.value, str))):
+                raise AnalysisError(
+                    "CASE with string results supports literal branch "
+                    "values only")
+            vals.append(v.value)
+        if self.otherwise is not None:
+            if not (isinstance(self.otherwise, Literal)
+                    and (self.otherwise.value is None
+                         or isinstance(self.otherwise.value, str))):
+                raise AnalysisError(
+                    "CASE with string results supports a literal ELSE "
+                    "only")
+            vals.append(self.otherwise.value)
+        else:
+            vals.append(None)
+        else_code = len(vals) - 1
+        codes = jnp.full((batch.capacity,), else_code, jnp.int32)
+        for i, (cond, _v) in reversed(list(enumerate(self.branches))):
+            cv = cond.eval(batch)
+            cond_true = cv.data
+            if cv.validity is not None:
+                cond_true = cond_true & cv.validity
+            codes = jnp.where(cond_true, jnp.int32(i), codes)
+        dictionary = pa.array([v if v is not None else "" for v in vals],
+                              type=pa.string())
+        null_codes = [i for i, v in enumerate(vals) if v is None]
+        validity = None
+        if null_codes:
+            validity = jnp.ones((batch.capacity,), jnp.bool_)
+            for nc in null_codes:
+                validity = validity & (codes != nc)
+        return Vec(codes, T.STRING, validity, dictionary)
+
     def eval(self, batch):
         out_dtype = self.dtype(batch.schema())
+        if isinstance(out_dtype, T.StringType):
+            return self._eval_string(batch)
         if self.otherwise is not None:
             acc = cast_vec(self.otherwise.eval(batch), out_dtype)
             acc_data, acc_val = acc.data, acc.validity

@@ -54,11 +54,15 @@ class AccSpec:
 
 
 def _max_of(dt):
+    if np.dtype(dt) == np.dtype(np.bool_):
+        return np.array(True)
     return np.array(np.finfo(dt).max if np.issubdtype(dt, np.floating)
                     else np.iinfo(dt).max, dt)
 
 
 def _min_of(dt):
+    if np.dtype(dt) == np.dtype(np.bool_):
+        return np.array(False)
     return np.array(np.finfo(dt).min if np.issubdtype(dt, np.floating)
                     else np.iinfo(dt).min, dt)
 
@@ -96,7 +100,22 @@ class AggregateFunction:
         raise NotImplementedError
 
     def references(self) -> set:
-        return self.child.references() if self.child is not None else set()
+        out = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    def with_args(self, args) -> "AggregateFunction":
+        """Copy with argument expressions replaced — the ONE seam plan
+        rewriters (scope rewrite, project collapse, map_expressions)
+        use, so multi-argument aggregates (corr/covar) are never
+        silently skipped by single-child walks."""
+        import copy
+        nf = copy.copy(self)
+        nf.children = tuple(args)
+        if len(args) == 1:
+            nf.child = args[0]
+        return nf
 
     def alias(self, name: str) -> "AggExpr":
         return AggExpr(self, name)
@@ -381,6 +400,410 @@ class Min(_MinMax):
 
 class Max(_MinMax):
     _reduce = "max"
+
+
+class First(AggregateFunction):
+    """first(x[, ignorenulls]): value at the smallest row position
+    (non-deterministic across shuffles, like the reference's First —
+    interfaces.scala). Each 32-bit word of the value is packed as
+    (pos << 33 | isnull << 32 | word) under a MIN reduce; positions are
+    unique, so every word accumulator independently picks the SAME
+    winning row — the partial/final split and mesh merges work
+    unchanged. 64-bit types carry two word accumulators."""
+
+    _reduce = "min"
+    _name = "first"
+
+    def __init__(self, child, ignorenulls: bool = False):
+        super().__init__(child)
+        self.ignorenulls = ignorenulls
+        self.output_dictionary = None
+
+    def result_type(self, schema):
+        return self.child.dtype(schema)
+
+    def _wide(self, schema) -> bool:
+        dt = self.child.dtype(schema)
+        if isinstance(dt, T.StringType):
+            return False  # dictionary codes are int32
+        return np.dtype(dt.np_dtype).itemsize > 4
+
+    def accumulators(self, schema):
+        specs = [AccSpec(f"{self._name}_w0", np.dtype(np.int64),
+                         self._reduce)]
+        if self._wide(schema):
+            specs.append(AccSpec(f"{self._name}_w1", np.dtype(np.int64),
+                                 self._reduce))
+        specs.append(AccSpec("cnt", np.dtype(np.int64), "sum", width=8))
+        return specs
+
+    def update(self, batch, sel):
+        v = self.child.eval(batch)
+        self.output_dictionary = v.dictionary
+        cap = batch.capacity
+        # min reduce picks the smallest position (first); max the
+        # largest (last) — the position rides the high packed bits
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        isnull = jnp.zeros((cap,), jnp.int64) if v.validity is None \
+            else (~v.validity).astype(jnp.int64)
+        data = v.data
+        if data.dtype == jnp.bool_:
+            data = data.astype(jnp.int32)
+        if data.dtype == jnp.float64:
+            # TPU's X64 rewrite cannot bitcast 64-bit floats; carry a
+            # double-float (hi, lo) f32 pair instead — reconstruction
+            # hi + lo is exact to ~2^-48 relative (documented deviation)
+            hi = data.astype(jnp.float32)
+            lo = (data - hi.astype(jnp.float64)).astype(jnp.float32)
+            words = [hi.view(jnp.int32).astype(jnp.int64)
+                     & jnp.int64(0xFFFFFFFF),
+                     lo.view(jnp.int32).astype(jnp.int64)
+                     & jnp.int64(0xFFFFFFFF)]
+        elif np.dtype(data.dtype).itemsize > 4:
+            wide = data.astype(jnp.int64)
+            words = [wide & jnp.int64(0xFFFFFFFF),
+                     (wide >> 32) & jnp.int64(0xFFFFFFFF)]
+        else:
+            if data.dtype == jnp.float32:
+                bits = data.view(jnp.int32)  # bit pattern, same width
+            elif data.dtype != jnp.int32:
+                bits = data.astype(jnp.int32)  # widen narrow ints
+            else:
+                bits = data
+            words = [bits.astype(jnp.int64) & jnp.int64(0xFFFFFFFF)]
+        m = batch.selection_mask() if sel is None else sel
+        contributing = m
+        if self.ignorenulls and v.validity is not None:
+            contributing = contributing & v.validity
+        neutral = jnp.asarray(_max_of(np.dtype(np.int64))
+                              if self._reduce == "min"
+                              else _min_of(np.dtype(np.int64)))
+        out = []
+        for w in words:
+            packed = (pos << 33) | (isnull << 32) | w
+            out.append(jnp.where(contributing, packed, neutral))
+        out.append(contributing.astype(jnp.int64))
+        return out
+
+    def _unpack(self, word_accs, schema, xp):
+        """Packed word accumulators -> (value, isnull-of-winner)."""
+        dt = self.result_type(schema)
+        words = [xp.asarray(p) & xp.int64(0xFFFFFFFF) for p in word_accs]
+        isnull = ((xp.asarray(word_accs[0]) >> 32) & 1) \
+            .astype(bool if xp is np else jnp.bool_)
+        out_np = np.dtype(dt.np_dtype)
+        if len(words) == 2:
+            if out_np == np.dtype(np.float64):
+                hi = words[0].astype(xp.uint32).view(xp.int32) \
+                    .view(xp.float32).astype(xp.float64)
+                lo = words[1].astype(xp.uint32).view(xp.int32) \
+                    .view(xp.float32).astype(xp.float64)
+                return hi + lo, isnull
+            wide = (words[1] << 32) | words[0]
+            return wide, isnull
+        low32 = words[0].astype(xp.uint32).view(xp.int32)
+        if self.output_dictionary is not None or \
+                out_np == np.dtype(np.int32):
+            return low32, isnull
+        if np.issubdtype(out_np, np.floating):
+            return low32.view(xp.float32), isnull
+        if out_np == np.dtype(np.bool_):
+            return low32.astype(bool if xp is np else jnp.bool_), isnull
+        return low32.astype(out_np), isnull
+
+    def finalize(self, accs, schema):
+        cnt = np.asarray(accs[-1])
+        val, isnull = self._unpack([np.asarray(a) for a in accs[:-1]],
+                                   schema, np)
+        return val, (cnt > 0) & ~isnull
+
+    def device_finalize(self, accs, schema):
+        val, isnull = self._unpack(accs[:-1], schema, jnp)
+        return val, (accs[-1] > 0) & ~isnull
+
+    def __repr__(self):
+        return f"{self._name}({self.child!r})"
+
+
+class Last(First):
+    _reduce = "max"
+    _name = "last"
+
+
+class AnyValue(First):
+    _name = "any_value"
+
+    def __repr__(self):
+        return f"any_value({self.child!r})"
+
+
+class _TwoChildAgg(AggregateFunction):
+    """Base for two-input declarative aggregates (corr/covar)."""
+
+    def __init__(self, x: Expression, y: Expression):
+        self.child = None
+        self.x = x
+        self.y = y
+        self.children = (x, y)
+
+    def with_args(self, args):
+        import copy
+        nf = copy.copy(self)
+        nf.x, nf.y = args
+        nf.children = tuple(args)
+        return nf
+
+    def references(self):
+        return self.x.references() | self.y.references()
+
+    def result_type(self, schema):
+        return T.DOUBLE
+
+    def _xy(self, batch, sel):
+        vx = self.x.eval(batch)
+        vy = self.y.eval(batch)
+        m = sel
+        for v in (vx, vy):
+            if v.validity is not None:
+                m = v.validity if m is None else (m & v.validity)
+        x = cast_vec(vx, T.DOUBLE).data
+        y = cast_vec(vy, T.DOUBLE).data
+        cnt = jnp.ones((batch.capacity,), jnp.int64)
+        if m is not None:
+            x = jnp.where(m, x, 0.0)
+            y = jnp.where(m, y, 0.0)
+            cnt = jnp.where(m, cnt, 0)
+        return x, y, cnt
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.x!r}, {self.y!r})"
+
+
+class Corr(_TwoChildAgg):
+    """Pearson correlation via power sums (reference:
+    Corr in CentralMomentAgg.scala, merge-formula form)."""
+
+    def accumulators(self, schema):
+        return [AccSpec("cnt", np.dtype(np.int64), "sum", width=8)] + \
+            [AccSpec(s, np.dtype(np.float64), "sum")
+             for s in ("sx", "sy", "sxx", "syy", "sxy")]
+
+    def update(self, batch, sel):
+        x, y, cnt = self._xy(batch, sel)
+        return [cnt, x, y, x * x, y * y, x * y]
+
+    def _finish(self, cnt, sx, sy, sxx, syy, sxy, xp):
+        n = xp.maximum(cnt, 1).astype(np.float64) if xp is np else \
+            xp.maximum(cnt, 1).astype(jnp.float64)
+        cov = sxy - sx * sy / n
+        vx = sxx - sx * sx / n
+        vy = syy - sy * sy / n
+        denom = xp.sqrt(xp.maximum(vx, 0.0) * xp.maximum(vy, 0.0))
+        safe = xp.where(denom > 0, denom, 1.0)
+        out = cov / safe
+        valid = (cnt > 1) & (denom > 0)
+        return out, valid
+
+    def finalize(self, accs, schema):
+        return self._finish(np.asarray(accs[0]), *map(np.asarray, accs[1:]),
+                            np)
+
+    def device_finalize(self, accs, schema):
+        return self._finish(accs[0], *accs[1:], jnp)
+
+
+class _Covar(_TwoChildAgg):
+    _ddof = 1
+
+    def accumulators(self, schema):
+        return [AccSpec("cnt", np.dtype(np.int64), "sum", width=8),
+                AccSpec("sx", np.dtype(np.float64), "sum"),
+                AccSpec("sy", np.dtype(np.float64), "sum"),
+                AccSpec("sxy", np.dtype(np.float64), "sum")]
+
+    def update(self, batch, sel):
+        x, y, cnt = self._xy(batch, sel)
+        return [cnt, x, y, x * y]
+
+    def _finish(self, cnt, sx, sy, sxy, xp):
+        fl = np.float64 if xp is np else jnp.float64
+        n = xp.maximum(cnt, 1).astype(fl)
+        denom = xp.maximum(cnt - self._ddof, 1).astype(fl)
+        out = (sxy - sx * sy / n) / denom
+        valid = cnt > self._ddof
+        return out, valid
+
+    def finalize(self, accs, schema):
+        return self._finish(*map(np.asarray, accs), np)
+
+    def device_finalize(self, accs, schema):
+        return self._finish(*accs, jnp)
+
+
+class CovarSamp(_Covar):
+    _ddof = 1
+
+
+class CovarPop(_Covar):
+    _ddof = 0
+
+
+class _HigherMoment(AggregateFunction):
+    """skewness/kurtosis via raw power sums (reference:
+    CentralMomentAgg.scala Skewness/Kurtosis, population form)."""
+
+    _order = 3
+
+    def result_type(self, schema):
+        return T.DOUBLE
+
+    def accumulators(self, schema):
+        return [AccSpec("cnt", np.dtype(np.int64), "sum", width=8)] + \
+            [AccSpec(f"s{k}", np.dtype(np.float64), "sum")
+             for k in range(1, self._order + 1)]
+
+    def update(self, batch, sel):
+        v, m = self._eval_child(batch, sel)
+        x = cast_vec(v, T.DOUBLE).data
+        cnt = jnp.ones((batch.capacity,), jnp.int64)
+        if m is not None:
+            x = jnp.where(m, x, 0.0)
+            cnt = jnp.where(m, cnt, 0)
+        out = [cnt]
+        p = x
+        for _ in range(self._order):
+            out.append(p)
+            p = p * x
+        return out
+
+    def _moments(self, accs, xp):
+        fl = np.float64 if xp is np else jnp.float64
+        cnt = accs[0]
+        n = xp.maximum(cnt, 1).astype(fl)
+        mean = accs[1] / n
+        m2 = accs[2] / n - mean * mean
+        return cnt, n, mean, xp.maximum(m2, 0.0)
+
+    def finalize(self, accs, schema):
+        return self._finish([np.asarray(a) for a in accs], np)
+
+    def device_finalize(self, accs, schema):
+        return self._finish(accs, jnp)
+
+
+class Skewness(_HigherMoment):
+    _order = 3
+
+    def _finish(self, accs, xp):
+        cnt, n, mean, m2 = self._moments(accs, xp)
+        m3 = accs[3] / n - 3 * mean * (accs[2] / n) + 2 * mean ** 3
+        sd = xp.sqrt(m2)
+        safe = xp.where(sd > 0, sd, 1.0)
+        out = m3 / (safe ** 3)
+        return out, (cnt > 0) & (m2 > 0)
+
+
+class Kurtosis(_HigherMoment):
+    """Excess kurtosis m4/m2^2 - 3 (the reference's Kurtosis)."""
+    _order = 4
+
+    def _finish(self, accs, xp):
+        cnt, n, mean, m2 = self._moments(accs, xp)
+        m4 = (accs[4] / n - 4 * mean * (accs[3] / n)
+              + 6 * mean ** 2 * (accs[2] / n) - 3 * mean ** 4)
+        safe = xp.where(m2 > 0, m2, 1.0)
+        out = m4 / (safe * safe) - 3.0
+        return out, (cnt > 0) & (m2 > 0)
+
+
+class _BoolAggBase(AggregateFunction):
+    _reduce = "min"  # bool_and: min over {0,1}
+
+    def result_type(self, schema):
+        return T.BOOLEAN
+
+    def accumulators(self, schema):
+        return [AccSpec(self._reduce, np.dtype(np.bool_), self._reduce),
+                AccSpec("cnt", np.dtype(np.int64), "sum", width=8)]
+
+    def update(self, batch, sel):
+        v, m = self._eval_child(batch, sel)
+        x = v.data.astype(jnp.bool_)
+        cnt = jnp.ones((batch.capacity,), jnp.int64)
+        if m is not None:
+            neutral = self._reduce == "min"  # True for and, False for or
+            x = jnp.where(m, x, neutral)
+            cnt = jnp.where(m, cnt, 0)
+        return [x, cnt]
+
+    def finalize(self, accs, schema):
+        return accs[0].astype(bool), accs[1] > 0
+
+    def device_finalize(self, accs, schema):
+        return accs[0], accs[1] > 0
+
+
+class BoolAnd(_BoolAggBase):
+    _reduce = "min"
+
+
+class BoolOr(_BoolAggBase):
+    _reduce = "max"
+
+
+class CountIf(AggregateFunction):
+    """count_if(pred): rows where the predicate is true."""
+
+    def result_type(self, schema):
+        return T.LONG
+
+    def result_nullable(self, schema):
+        return False
+
+    def accumulators(self, schema):
+        return [AccSpec("count", np.dtype(np.int64), "sum", width=8)]
+
+    def update(self, batch, sel):
+        v, m = self._eval_child(batch, sel)
+        x = v.data.astype(jnp.bool_)
+        if m is not None:
+            x = x & m
+        return [x.astype(jnp.int64)]
+
+    def finalize(self, accs, schema):
+        return accs[0], None
+
+    def device_finalize(self, accs, schema):
+        return accs[0], None
+
+
+class SumDistinct(AggregateFunction):
+    """sum(DISTINCT x): planning marker, rewritten by
+    RewriteDistinctAggregates into sum over a (groups, x) dedupe."""
+
+    def result_type(self, schema):
+        return Sum(self.child).result_type(schema)
+
+    def accumulators(self, schema):
+        raise NotImplementedError(
+            "sum(DISTINCT) must be rewritten before execution")
+
+    def __repr__(self):
+        return f"sum(DISTINCT {self.child!r})"
+
+
+class AvgDistinct(AggregateFunction):
+    """avg(DISTINCT x): planning marker (see SumDistinct)."""
+
+    def result_type(self, schema):
+        return Avg(self.child).result_type(schema)
+
+    def accumulators(self, schema):
+        raise NotImplementedError(
+            "avg(DISTINCT) must be rewritten before execution")
+
+    def __repr__(self):
+        return f"avg(DISTINCT {self.child!r})"
 
 
 @dataclass

@@ -133,6 +133,8 @@ def concat(*parts) -> Expression:
     """concat of string literals around ONE string column (general
     column-column concat needs a product dictionary — unsupported)."""
     exprs = [_expr(p) for p in parts]
+    if any(isinstance(p, Literal) and p.value is None for p in exprs):
+        return Literal(None, T.STRING)  # NULL in -> NULL out
     col_idx = [i for i, p in enumerate(exprs)
                if not isinstance(p, Literal)]
     if len(col_idx) != 1:
@@ -213,3 +215,254 @@ class _WhenBuilder(Expression):
 
 def when(cond: Expression, value) -> _WhenBuilder:
     return _WhenBuilder([(cond, _expr(value))])
+
+
+# ---------------------------------------------------------------------------
+# Round-4 breadth: math / datetime / string / null / extended aggregates
+# (registry-driven SQL names live in sql/registry.py; these are the
+# pyspark-shaped DSL constructors)
+# ---------------------------------------------------------------------------
+
+from . import expr_fns as _X  # noqa: E402
+from .expr_agg import (AnyValue as _AnyValue, AvgDistinct as _AvgDistinct,  # noqa: E402
+                       BoolAnd as _BoolAnd, BoolOr as _BoolOr,
+                       Corr as _Corr, CountIf as _CountIf,
+                       CovarPop as _CovarPop, CovarSamp as _CovarSamp,
+                       First as _First, Kurtosis as _Kurtosis,
+                       Last as _Last, Skewness as _Skewness,
+                       SumDistinct as _SumDistinct)
+
+
+def _u1(cls):
+    def f(e):
+        return cls(_expr(e))
+    return f
+
+
+abs = _u1(_X.Abs)  # noqa: A001
+sqrt = _u1(_X.Sqrt)
+cbrt = _u1(_X.Cbrt)
+exp = _u1(_X.Exp)
+expm1 = _u1(_X.Expm1)
+log = _u1(_X.Ln)
+log10 = _u1(_X.Log10)
+log2 = _u1(_X.Log2)
+log1p = _u1(_X.Log1p)
+sin = _u1(_X.Sin)
+cos = _u1(_X.Cos)
+tan = _u1(_X.Tan)
+asin = _u1(_X.Asin)
+acos = _u1(_X.Acos)
+atan = _u1(_X.Atan)
+sinh = _u1(_X.Sinh)
+cosh = _u1(_X.Cosh)
+tanh = _u1(_X.Tanh)
+degrees = _u1(_X.Degrees)
+radians = _u1(_X.Radians)
+rint = _u1(_X.Rint)
+signum = _u1(_X.Signum)
+ceil = _u1(_X.Ceil)
+floor = _u1(_X.Floor)
+factorial = _u1(_X.Factorial)
+bit_count = _u1(_X.BitCount)
+bitwise_not = _u1(_X.BitwiseNot)
+isnan = _u1(_X.IsNan)
+quarter = _u1(_X.Quarter)
+dayofweek = _u1(_X.DayOfWeek)
+weekday = _u1(_X.WeekDay)
+dayofyear = _u1(_X.DayOfYear)
+weekofyear = _u1(_X.WeekOfYear)
+last_day = _u1(_X.LastDay)
+ltrim = _u1(_X.Ltrim)
+rtrim = _u1(_X.Rtrim)
+reverse = _u1(_X.Reverse)
+initcap = _u1(_X.InitCap)
+ascii = _u1(_X.Ascii)  # noqa: A001
+
+
+def round(e, scale: int = 0):  # noqa: A001
+    return _X.Round(_expr(e), scale)
+
+
+def pow(a, b):  # noqa: A001
+    return _X.Pow(_expr(a), _expr(b))
+
+
+power = pow
+
+
+def atan2(a, b):
+    return _X.Atan2(_expr(a), _expr(b))
+
+
+def hypot(a, b):
+    return _X.Hypot(_expr(a), _expr(b))
+
+
+def shiftleft(e, n):
+    return _X.ShiftLeft(_expr(e), _expr(n))
+
+
+def shiftright(e, n):
+    return _X.ShiftRight(_expr(e), _expr(n))
+
+
+def greatest(*args):
+    return _X.Greatest(*[_expr(a) for a in args])
+
+
+def least(*args):
+    return _X.Least(*[_expr(a) for a in args])
+
+
+def coalesce(*args):
+    from .expr import Coalesce
+    return Coalesce(*[_expr(a) for a in args])
+
+
+def nvl(a, b):
+    return _X.Nvl(_expr(a), _expr(b))
+
+
+ifnull = nvl
+
+
+def nvl2(a, b, c):
+    return _X.Nvl2(_expr(a), _expr(b), _expr(c))
+
+
+def nullif(a, b):
+    return _X.NullIf(_expr(a), _expr(b))
+
+
+def nanvl(a, b):
+    return _X.Nanvl(_expr(a), _expr(b))
+
+
+def expr_if(cond, a, b):
+    return _X.If(cond, _expr(a), _expr(b))
+
+
+def next_day(e, day_name: str):
+    return _X.NextDay(_expr(e), day_name)
+
+
+def add_months(e, n):
+    return _X.AddMonths(_expr(e), _expr(n))
+
+
+def months_between(end, start):
+    return _X.MonthsBetween(_expr(end), _expr(start))
+
+
+def datediff(end, start):
+    return _X.DateDiff(_expr(end), _expr(start))
+
+
+def trunc(e, fmt: str):
+    return _X.TruncDate(_expr(e), fmt)
+
+
+def make_date(y, m, d):
+    return _X.MakeDate(_expr(y), _expr(m), _expr(d))
+
+
+def lpad(e, length: int, pad: str = " "):
+    return _X.Lpad(_expr(e), length, pad)
+
+
+def rpad(e, length: int, pad: str = " "):
+    return _X.Rpad(_expr(e), length, pad)
+
+
+def translate(e, matching: str, replace: str):
+    return _X.Translate(_expr(e), matching, replace)
+
+
+def repeat(e, n: int):
+    return _X.Repeat(_expr(e), n)
+
+
+def regexp_replace(e, pattern: str, replacement: str):
+    return _X.RegexpReplace(_expr(e), pattern, replacement)
+
+
+def regexp_extract(e, pattern: str, idx: int = 1):
+    return _X.RegexpExtract(_expr(e), pattern, idx)
+
+
+def rlike(e, pattern: str):
+    return _X.RLike(_expr(e), pattern)
+
+
+def instr(e, sub: str):
+    return _X.Instr(_expr(e), sub)
+
+
+def contains(e, sub: str):
+    return _X.Contains(_expr(e), sub)
+
+
+def startswith(e, prefix: str):
+    return _X.StartsWith(_expr(e), prefix)
+
+
+def endswith(e, suffix: str):
+    return _X.EndsWith(_expr(e), suffix)
+
+
+def replace(e, search: str, replacement: str = ""):
+    return _X.StringReplace(_expr(e), search, replacement)
+
+
+# extended aggregates
+def first(e, ignorenulls: bool = False):
+    return _First(_expr(e), ignorenulls)
+
+
+def last(e, ignorenulls: bool = False):
+    return _Last(_expr(e), ignorenulls)
+
+
+def any_value(e):
+    return _AnyValue(_expr(e))
+
+
+def corr(x, y):
+    return _Corr(_expr(x), _expr(y))
+
+
+def covar_samp(x, y):
+    return _CovarSamp(_expr(x), _expr(y))
+
+
+def covar_pop(x, y):
+    return _CovarPop(_expr(x), _expr(y))
+
+
+def skewness(e):
+    return _Skewness(_expr(e))
+
+
+def kurtosis(e):
+    return _Kurtosis(_expr(e))
+
+
+def bool_and(e):
+    return _BoolAnd(_expr(e))
+
+
+def bool_or(e):
+    return _BoolOr(_expr(e))
+
+
+def count_if(e):
+    return _CountIf(_expr(e))
+
+
+def sum_distinct(e):
+    return _SumDistinct(_expr(e))
+
+
+def avg_distinct(e):
+    return _AvgDistinct(_expr(e))

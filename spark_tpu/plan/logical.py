@@ -135,8 +135,7 @@ def iter_expressions(plan: LogicalPlan):
         elif isinstance(n, Aggregate):
             yield from n.group_exprs
             for a in n.agg_exprs:
-                if a.func.child is not None:
-                    yield a.func.child
+                yield from a.func.children
         elif isinstance(n, Sort):
             for o in n.orders:
                 yield o.child
@@ -179,11 +178,8 @@ def map_expressions(plan: LogicalPlan, f) -> LogicalPlan:
             aggs = []
             for a in node.agg_exprs:
                 func = a.func
-                if func.child is not None:
-                    nf = _copy.copy(func)
-                    nf.child = f(func.child)
-                    nf.children = (nf.child,)
-                    func = nf
+                if func.children:
+                    func = func.with_args([f(c) for c in func.children])
                 aggs.append(type(a)(func, a.out_name))
             return Aggregate(node.child, [f(g) for g in node.group_exprs],
                              aggs)

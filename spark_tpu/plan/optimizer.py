@@ -285,17 +285,14 @@ class CollapseProjectIntoAggregate(Rule):
                 if s is None:
                     return node
                 new_groups.append(Alias(s, g.name()))
-            import copy
             new_aggs = []
             for a in node.agg_exprs:
                 func = a.func
-                if func.child is not None:
-                    s = subst(func.child)
-                    if s is None:
+                if func.children:
+                    args = [subst(c) for c in func.children]
+                    if any(s is None for s in args):
                         return node
-                    func = copy.copy(func)
-                    func.child = s
-                    func.children = (s,)
+                    func = func.with_args(args)
                 new_aggs.append(type(a)(func, a.out_name))
             return Aggregate(proj.child, new_groups, new_aggs)
 
@@ -303,41 +300,46 @@ class CollapseProjectIntoAggregate(Rule):
 
 
 class RewriteDistinctAggregates(Rule):
-    """count(DISTINCT x) -> count(x) over a (groups, x) dedupe aggregate —
-    the single-distinct case of the reference's
-    `AggUtils.planAggregateWithOneDistinct` (Expand-based mixed plans are
-    not supported; mixing distinct and plain aggregates raises)."""
+    """count/sum/avg(DISTINCT x) -> the plain aggregate over a
+    (groups, x) dedupe aggregate — the single-distinct case of the
+    reference's `AggUtils.planAggregateWithOneDistinct` (Expand-based
+    mixed plans are not supported; mixing distinct and plain aggregates
+    raises)."""
 
     name = "RewriteDistinctAggregates"
 
     def apply(self, plan):
-        from ..expr_agg import AggExpr, Count, CountDistinct
+        from ..expr_agg import (AggExpr, Avg, AvgDistinct, Count,
+                                CountDistinct, Sum, SumDistinct)
+        markers = {CountDistinct: Count, SumDistinct: Sum,
+                   AvgDistinct: Avg}
 
         def f(node):
             if not isinstance(node, Aggregate):
                 return node
             distinct = [a for a in node.agg_exprs
-                        if isinstance(a.func, CountDistinct)]
+                        if type(a.func) in markers]
             if not distinct:
                 return node
             if len(distinct) != len(node.agg_exprs):
                 from ..expr import AnalysisError
                 raise AnalysisError(
-                    "mixing count(DISTINCT) with other aggregates is not "
-                    "supported yet")
+                    "mixing DISTINCT aggregates with plain aggregates is "
+                    "not supported yet")
             firsts = [a.func.child for a in distinct]
             from ..expr import structurally_equal
             if not all(structurally_equal(firsts[0], e) for e in firsts[1:]):
                 from ..expr import AnalysisError
                 raise AnalysisError(
-                    "multiple count(DISTINCT) on different expressions is "
-                    "not supported yet")
+                    "multiple DISTINCT aggregates on different expressions "
+                    "are not supported yet")
             dedup_key = Alias(firsts[0], "__distinct_key")
             inner = Aggregate(node.child,
                               list(node.group_exprs) + [dedup_key], [])
             outer_groups = [ColumnRef(g.name()) for g in node.group_exprs]
-            outer_aggs = [AggExpr(Count(ColumnRef("__distinct_key")),
-                                  a.out_name) for a in distinct]
+            outer_aggs = [AggExpr(markers[type(a.func)](
+                ColumnRef("__distinct_key")), a.out_name)
+                for a in distinct]
             return Aggregate(inner, outer_groups, outer_aggs)
 
         return plan.transform_up(f)

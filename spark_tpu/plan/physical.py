@@ -528,7 +528,8 @@ class HashAggregateExec(UnaryExec):
             for i, a in enumerate(self.agg_exprs):
                 data, validity = a.func.device_finalize(accs[i], base)
                 cols[a.out_name] = Column(
-                    data, a.func.result_type(base), validity)
+                    data, a.func.result_type(base), validity,
+                    getattr(a.func, "output_dictionary", None))
         ctx.add_metric(f"agg_groups", jnp.sum(occupied.astype(jnp.int32)))
         return Batch(cols, occupied)
 
@@ -619,7 +620,9 @@ class HashAggregateExec(UnaryExec):
             cols[g.name()] = Column(arr, dt, kv, dic)
         for i, a in enumerate(self.agg_exprs):
             data, validity = a.func.device_finalize(accs[i], base)
-            cols[a.out_name] = Column(data, a.func.result_type(base), validity)
+            cols[a.out_name] = Column(
+                data, a.func.result_type(base), validity,
+                getattr(a.func, "output_dictionary", None))
         return Batch(cols, occupied)
 
     def direct_partial_batch(self, tables, prep: "DirectAggPlan",

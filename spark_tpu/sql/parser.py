@@ -29,9 +29,12 @@ from ..expr import (Alias, AnalysisError, And, CaseWhen, Cast, Coalesce,
                     ExtractMonth, ExtractYear, GE, GT, In, IsNull, LE, LT,
                     Like, Literal, Lower, Mod, NE, Neg, Not, Or, SortOrder,
                     StringLength, Substring, Trim, Upper, date_literal)
-from ..expr_agg import (AggExpr, AggregateFunction, Avg, Count,
-                        CountDistinct, Max, Min, StddevPop, StddevSamp,
-                        Sum, VariancePop, VarianceSamp)
+from ..expr_agg import (AggExpr, AggregateFunction, AnyValue, Avg,
+                        AvgDistinct, BoolAnd, BoolOr, Corr, Count,
+                        CountDistinct, CountIf, CovarPop, CovarSamp, First,
+                        Kurtosis, Last, Max, Min, Skewness, StddevPop,
+                        StddevSamp, Sum, SumDistinct, VariancePop,
+                        VarianceSamp)
 from ..plan import logical as L
 from .lexer import ParseError, Token, tokenize
 
@@ -574,10 +577,24 @@ class Parser:
             return T.DecimalType(p, s)
         raise ParseError(f"unknown type {name!r}")
 
-    _AGGS = {"SUM": Sum, "AVG": Avg, "MIN": Min, "MAX": Max,
+    _AGGS = {"SUM": Sum, "AVG": Avg, "MEAN": Avg, "MIN": Min, "MAX": Max,
              "STDDEV": StddevSamp, "STDDEV_SAMP": StddevSamp,
              "STDDEV_POP": StddevPop, "VARIANCE": VarianceSamp,
              "VAR_SAMP": VarianceSamp, "VAR_POP": VariancePop}
+
+    #: DISTINCT-capable rewrite markers (RewriteDistinctAggregates)
+    _DISTINCT_AGGS = {"SUM": SumDistinct, "AVG": AvgDistinct,
+                      "MEAN": AvgDistinct}
+
+    #: single-argument extended aggregates
+    _AGGS_EXT = {"FIRST": First, "FIRST_VALUE": First, "LAST": Last,
+                 "LAST_VALUE": Last, "ANY_VALUE": AnyValue,
+                 "SKEWNESS": Skewness, "KURTOSIS": Kurtosis,
+                 "BOOL_AND": BoolAnd, "EVERY": BoolAnd, "BOOL_OR": BoolOr,
+                 "ANY": BoolOr, "SOME": BoolOr, "COUNT_IF": CountIf}
+
+    #: two-argument aggregates (corr/covar)
+    _AGGS2 = {"CORR": Corr, "COVAR_SAMP": CovarSamp, "COVAR_POP": CovarPop}
 
     def parse_function(self) -> Expression:
         name = self._ident().upper()
@@ -595,10 +612,26 @@ class Parser:
             return _AggCall(Count(e))
         if name in self._AGGS:
             if self.eat_kw("DISTINCT"):
-                raise ParseError(f"{name}(DISTINCT ...) is not supported yet")
+                marker = self._DISTINCT_AGGS.get(name)
+                if marker is None:
+                    raise ParseError(
+                        f"{name}(DISTINCT ...) is not supported")
+                e = self.parse_expr()
+                self.expect_op(")")
+                return _AggCall(marker(e))
             e = self.parse_expr()
             self.expect_op(")")
             return _AggCall(self._AGGS[name](e))
+        if name in self._AGGS_EXT:
+            e = self.parse_expr()
+            self.expect_op(")")
+            return _AggCall(self._AGGS_EXT[name](e))
+        if name in self._AGGS2:
+            x = self.parse_expr()
+            self.expect_op(",")
+            y = self.parse_expr()
+            self.expect_op(")")
+            return _AggCall(self._AGGS2[name](x, y))
         if name in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
             self.expect_op(")")
             return _RankingCall(name.lower(), None, 0, None)
@@ -668,32 +701,18 @@ class Parser:
         raise ParseError("OVER applies to window or aggregate functions")
 
     def _scalar_function(self, name: str, args: List[Expression]) -> Expression:
-        if name == "YEAR" and len(args) == 1:
-            return ExtractYear(args[0])
-        if name == "MONTH" and len(args) == 1:
-            return ExtractMonth(args[0])
-        if name in ("DAY", "DAYOFMONTH") and len(args) == 1:
-            return ExtractDay(args[0])
-        if name == "DATE_ADD" and len(args) == 2:
-            return DateAdd(args[0], args[1])
-        if name == "DATE_SUB" and len(args) == 2:
-            return DateAdd(args[0], Neg(args[1]))
-        if name == "UPPER" and len(args) == 1:
-            return Upper(args[0])
-        if name == "LOWER" and len(args) == 1:
-            return Lower(args[0])
-        if name == "TRIM" and len(args) == 1:
-            return Trim(args[0])
-        if name == "LENGTH" and len(args) == 1:
-            return StringLength(args[0])
         if name in ("SUBSTRING", "SUBSTR") and len(args) == 3:
             start = args[1]
             length = args[2]
             if not (isinstance(start, Literal) and isinstance(length, Literal)):
                 raise ParseError("SUBSTRING requires literal start/length")
             return Substring(args[0], int(start.value), int(length.value))
-        if name == "COALESCE":
-            return Coalesce(*args)
+        # registry-driven dispatch (reference: FunctionRegistry.scala);
+        # replaces the round-3 hand list
+        from .registry import lookup
+        out = lookup(name, args)
+        if out is not None:
+            return out
         raise ParseError(f"unknown function {name!r}")
 
 
@@ -920,8 +939,8 @@ class _Scope:
                     out.add((owners[0], node.name()))
                 return
             if isinstance(node, _AggCall):
-                if node.func.child is not None:
-                    walk(node.func.child)
+                for c in node.func.children:
+                    walk(c)
                 return
             for c in node.children:
                 walk(c)
@@ -947,12 +966,9 @@ class _Scope:
                 return ColumnRef(self.current[(owners[0], e.name())])
             return e
         if isinstance(e, _AggCall):
-            if e.func.child is not None:
-                import copy
-                func = copy.copy(e.func)
-                func.child = self.rewrite(e.func.child)
-                func.children = (func.child,)
-                return _AggCall(func)
+            if e.func.children:
+                return _AggCall(e.func.with_args(
+                    [self.rewrite(c) for c in e.func.children]))
             return e
         return e.map_children(self.rewrite)
 

@@ -137,7 +137,7 @@ def test_subquery_in_from(tiny):
 def test_parse_errors():
     from spark_tpu.sql.parser import Parser
     for bad in ("SELECT", "SELECT FROM t", "SELECT a FROM t WHERE",
-                "SELECT a FROM t GROUP", "SELECT sum(DISTINCT a) FROM t"):
+                "SELECT a FROM t GROUP", "SELECT min(DISTINCT a) FROM t"):
         with pytest.raises((ParseError, Exception)):
             Parser(bad).parse_statement()
 
