@@ -176,6 +176,12 @@ def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,
         # the source is device-synthesized, so the whole chunk loop fuses
         # into ONE dispatch (a lax.fori_loop with carried tables) — no
         # host round-trip per chunk
+        if any(a.func.uses_row_base for a in agg.agg_exprs) \
+                and n_chunks * chunk_rows >= (1 << 30):
+            raise RuntimeError(
+                "first/last over a streamed range exceeds the 2^30 "
+                f"packed-position bound ({rows_total} rows)")
+
         @jax.jit
         def run():
             def body(i, tables):
@@ -184,7 +190,9 @@ def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,
                     chain, ctx,
                     _range_chunk(leaf, i.astype(jnp.int64) * chunk_rows,
                                  chunk_rows, rows_total))
-                return agg.direct_update_tables(tables, b, prep, conf)
+                return agg.direct_update_tables(
+                    tables, b, prep, conf,
+                    row_base=i.astype(jnp.int64) * chunk_rows)
 
             tables = jax.lax.fori_loop(0, n_chunks, body,
                                        agg.direct_init_tables(prep))
@@ -232,20 +240,22 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
                 return None
 
             if joins:
-                def update(tables, b, bb):
+                def update(tables, b, bb, row_base):
                     ctx = P.ExecContext(conf)
                     b = _replay_chain(chain, ctx, b, bb)
-                    new = agg.direct_update_tables(tables, b, prep0, conf)
+                    new = agg.direct_update_tables(tables, b, prep0, conf,
+                                                   row_base=row_base)
                     return new, ctx.flags, ctx.metrics
 
                 # no donation: a join-capacity overflow must re-run the
                 # SAME chunk against the pre-update tables
                 bundle = (prep0, jax.jit(update))
             else:
-                def update(tables, b):
+                def update(tables, b, row_base):
                     ctx = P.ExecContext(conf)
                     b = _replay_chain(chain, ctx, b)
-                    return agg.direct_update_tables(tables, b, prep0, conf)
+                    return agg.direct_update_tables(tables, b, prep0, conf,
+                                                    row_base=row_base)
 
                 # join-free hot path: donate tables, no per-chunk host
                 # sync — the double-buffered host->HBM overlap
@@ -264,12 +274,29 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
     check_dicts = _dict_growth_guard(agg, prep)
     tables = agg.direct_init_tables(prep)
 
+    # running row base for position-packed aggregates: each chunk's
+    # stride covers the largest post-replay capacity (join out_caps only
+    # grow, so bases stay collision-free even across mid-run re-jits)
+    row_base = 0
+
+    def chunk_stride(b):
+        return max([b.capacity] + [j.out_cap or 0 for j in joins])
+
+    def check_bound(b):
+        if row_base + chunk_stride(b) >= (1 << 30) and \
+                any(a.func.uses_row_base for a in agg.agg_exprs):
+            raise RuntimeError(
+                "first/last over a streamed scan exceeds the 2^30 "
+                "packed-position bound")
+
     def run_chunk(tables, b):
         nonlocal update_fn
+        check_bound(b)
+        base = jnp.asarray(row_base, jnp.int64)
         if not joins:
-            return update_fn(tables, b)
+            return update_fn(tables, b, base)
         for _attempt in range(8):
-            new, flags, metrics = update_fn(tables, b, builds)
+            new, flags, metrics = update_fn(tables, b, builds, base)
             flags, metrics = jax.device_get((flags, metrics))
             overflow = [k for k, v in flags.items()
                         if k.startswith(("join_overflow_",
@@ -291,14 +318,18 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
                         j.out_cap = bucket_capacity(max(total, 8))
             # out_cap is part of describe(): re-jit under the new key,
             # then retry the SAME chunk against the pre-update tables
+            # (the grown out_cap widens the position stride — re-check)
             _prep2, update_fn = make_update()
+            check_bound(b)
         raise RuntimeError("streamed join capacity did not converge")
 
     check_dicts(first)
     tables = run_chunk(tables, first)
+    row_base += chunk_stride(first)
     for b in chunks:
         check_dicts(b)
         tables = run_chunk(tables, b)
+        row_base += chunk_stride(b)
 
     dict_overrides = dict(chunks.dictionaries) if hasattr(
         chunks, "dictionaries") else {}
@@ -398,11 +429,16 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
         if prep is None:
             return None
 
-        def update(tables, b):
+        def update(tables, b, chunk_base):
             t = jax.tree_util.tree_map(lambda x: x[0], tables)
             ctx = P.ExecContext(conf)
             local = _replay_chain(chain, ctx, b)
-            new = agg.direct_update_tables(t, local, prep, conf)
+            # unique packed positions: chunks stride the full chunk
+            # capacity (host counter), shards stride the local capacity
+            base = chunk_base + jax.lax.axis_index(AXIS) \
+                .astype(jnp.int64) * local.capacity
+            new = agg.direct_update_tables(t, local, prep, conf,
+                                           row_base=base)
             return jax.tree_util.tree_map(lambda x: x[None], new)
 
         def emit(tables):
@@ -410,7 +446,7 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
             return agg.direct_partial_batch(t, prep)
 
         update_step = jax.jit(shard_map(
-            update, mesh=mesh, in_specs=(Psp(AXIS), Psp(AXIS)),
+            update, mesh=mesh, in_specs=(Psp(AXIS), Psp(AXIS), Psp()),
             out_specs=Psp(AXIS), check_vma=False),
             donate_argnums=(0,))
         emit_step = jax.jit(shard_map(
@@ -430,11 +466,26 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
                for row in accs0])
 
     check_dicts = _dict_growth_guard(agg, prep)
+    chunk_base = 0
+    needs_base = any(a.func.uses_row_base for a in agg.agg_exprs)
+
+    def step(tables, b):
+        nonlocal chunk_base
+        padded = pad_batch_to_multiple(b, n)
+        if needs_base and chunk_base + padded.capacity >= (1 << 30):
+            raise RuntimeError(
+                "first/last over a streamed mesh scan exceeds the 2^30 "
+                "packed-position bound")
+        out = update_step(tables, padded,
+                          jnp.asarray(chunk_base, jnp.int64))
+        chunk_base += padded.capacity
+        return out
+
     check_dicts(first)
-    tables = update_step(tables, pad_batch_to_multiple(first, n))
+    tables = step(tables, first)
     for b in chunks:
         check_dicts(b)
-        tables = update_step(tables, pad_batch_to_multiple(b, n))
+        tables = step(tables, b)
 
     dict_overrides = dict(chunks.dictionaries) if hasattr(
         chunks, "dictionaries") else {}

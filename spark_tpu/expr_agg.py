@@ -70,6 +70,13 @@ def _min_of(dt):
 class AggregateFunction:
     """Base class. `child` may be None (COUNT(*))."""
 
+    # True for position-packed aggregates (First/Last/AnyValue) whose
+    # update() must receive a globally unique row base so that merges
+    # across chunks/shards never tie on in-chunk position (a tie lets
+    # the two word accumulators of a 64-bit value pick DIFFERENT rows,
+    # fabricating a value present in no input row).
+    uses_row_base = False
+
     def __init__(self, child: Optional[Expression] = None):
         self.child = child
         self.children = (child,) if child is not None else ()
@@ -413,6 +420,7 @@ class First(AggregateFunction):
 
     _reduce = "min"
     _name = "first"
+    uses_row_base = True
 
     def __init__(self, child, ignorenulls: bool = False):
         super().__init__(child)
@@ -437,13 +445,18 @@ class First(AggregateFunction):
         specs.append(AccSpec("cnt", np.dtype(np.int64), "sum", width=8))
         return specs
 
-    def update(self, batch, sel):
+    def update(self, batch, sel, row_base=None):
         v = self.child.eval(batch)
         self.output_dictionary = v.dictionary
         cap = batch.capacity
         # min reduce picks the smallest position (first); max the
-        # largest (last) — the position rides the high packed bits
+        # largest (last) — the position rides the high packed bits.
+        # `row_base` makes positions globally unique across merged
+        # chunks/shards (see AggregateFunction.uses_row_base); packed
+        # positions carry 30 bits, so callers bound base+cap < 2^30.
         pos = jnp.arange(cap, dtype=jnp.int64)
+        if row_base is not None:
+            pos = pos + jnp.asarray(row_base, jnp.int64)
         isnull = jnp.zeros((cap,), jnp.int64) if v.validity is None \
             else (~v.validity).astype(jnp.int64)
         data = v.data

@@ -24,6 +24,7 @@ import math
 import re
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -357,14 +358,11 @@ class BitCount(Expression):
 
     def eval(self, batch):
         v = self.children[0].eval(batch)
-        x = v.data.astype(jnp.uint64) if v.data.dtype == jnp.int64 \
-            else v.data.astype(jnp.uint32)
-        cnt = jnp.zeros(x.shape, jnp.int32)
-        while_bits = x
-        # popcount via the classic SWAR ladder is overkill; bit widths
-        # are static so an unrolled shift-add is fine for XLA
-        for shift in range(x.dtype.itemsize * 8):
-            cnt = cnt + ((while_bits >> shift) & 1).astype(jnp.int32)
+        # the reference widens to long and counts 64 bits
+        # (Long.bitCount), so negative narrow ints sign-extend:
+        # bit_count(-1) = 64 for every integral width
+        x = v.data.astype(jnp.int64).view(jnp.uint64)
+        cnt = jax.lax.population_count(x).astype(jnp.int32)
         return Vec(cnt, T.INT, v.validity)
 
     def __repr__(self):
@@ -442,13 +440,15 @@ class _GreatestLeast(Expression):
         out_dt = self.dtype(batch.schema())
         vs = [cast_vec(c.eval(batch), out_dt) for c in self.children]
         data, validity = vs[0].data, vs[0].validity
+        floating = jnp.issubdtype(vs[0].data.dtype, jnp.floating)
+        pick = type(self)._pick_float if floating else type(self)._pick
         if validity is None:
             validity = jnp.ones(data.shape, jnp.bool_)
         for v in vs[1:]:
             vvalid = v.validity if v.validity is not None else \
                 jnp.ones(v.data.shape, jnp.bool_)
             # NULLs are skipped (reference: greatest/least ignore nulls)
-            better = vvalid & (~validity | type(self)._pick(v.data, data))
+            better = vvalid & (~validity | pick(v.data, data))
             data = jnp.where(better, v.data, data)
             validity = validity | vvalid
         return Vec(data, out_dt, validity)
@@ -461,10 +461,22 @@ class Greatest(_GreatestLeast):
     _pick = staticmethod(lambda a, b: a > b)
     _name = "greatest"
 
+    @staticmethod
+    def _pick_float(a, b):
+        # the reference orders NaN as the LARGEST double: greatest
+        # prefers NaN over any number (including +inf)
+        return (jnp.isnan(a) & ~jnp.isnan(b)) | (a > b)
+
 
 class Least(_GreatestLeast):
     _pick = staticmethod(lambda a, b: a < b)
     _name = "least"
+
+    @staticmethod
+    def _pick_float(a, b):
+        # NaN is the largest double, so least only keeps NaN when every
+        # input is NaN — a number always replaces an accumulated NaN
+        return ~jnp.isnan(a) & (jnp.isnan(b) | (a < b))
 
 
 class IsNan(Expression):
@@ -771,10 +783,16 @@ class MakeDate(Expression):
         y = self.children[0].eval(batch)
         m = self.children[1].eval(batch)
         d = self.children[2].eval(batch)
-        ok = (m.data >= 1) & (m.data <= 12) & (d.data >= 1) & (d.data <= 31)
-        out = _days_from_civil(y.data.astype(jnp.int64),
-                               m.data.astype(jnp.int64),
-                               d.data.astype(jnp.int64))
+        y64 = y.data.astype(jnp.int64)
+        m64 = m.data.astype(jnp.int64)
+        d64 = d.data.astype(jnp.int64)
+        out = _days_from_civil(y64, m64, d64)
+        # round-trip through the calendar: invalid dates (make_date(
+        # 2023, 2, 30)) would silently roll into the next month; the
+        # reference returns NULL (non-ANSI) instead
+        ry, rm, rd = _civil_from_days(out)
+        ok = (ry.astype(jnp.int64) == y64) & \
+            (rm.astype(jnp.int64) == m64) & (rd.astype(jnp.int64) == d64)
         validity = _and_valid(
             _and_valid(y.validity, m.validity),
             _and_valid(d.validity, ok))

@@ -470,7 +470,7 @@ class HashAggregateExec(UnaryExec):
                 contribs.append(row)
         else:
             specs = [a.func.accumulators(base) for a in self.agg_exprs]
-            contribs = [a.func.update(batch, sel) for a in self.agg_exprs]
+            contribs = self._updates(batch, sel, ctx)
 
         domains = [agg_kernels.key_domain(g, v)
                    for g, v in zip(self.group_exprs, key_vecs)]
@@ -588,13 +588,38 @@ class HashAggregateExec(UnaryExec):
     def direct_init_tables(self, prep: "DirectAggPlan"):
         return agg_kernels.direct_init(prep.spans, prep.specs)
 
+    def _updates(self, batch: Batch, sel, ctx=None, row_base=None):
+        """Per-row accumulator contributions. Position-packed aggregates
+        (First/Last/AnyValue, `uses_row_base`) receive a globally unique
+        row base: `row_base` spaces host-driven chunks and the shard
+        index spaces mesh shards, so accumulator merges never tie on
+        in-chunk position (a tie would let the two word accumulators of
+        one 64-bit value each pick a different row)."""
+        if any(a.func.uses_row_base for a in self.agg_exprs):
+            base = jnp.asarray(0 if row_base is None else row_base,
+                               jnp.int64)
+            if ctx is not None and ctx.axis_name is not None \
+                    and ctx.n_shards > 1:
+                if ctx.n_shards * batch.capacity >= (1 << 30):
+                    raise RuntimeError(
+                        "first/last aggregation input exceeds the 2^30 "
+                        "packed-position bound "
+                        f"({ctx.n_shards} shards x {batch.capacity} rows)")
+                base = base + jax.lax.axis_index(ctx.axis_name) \
+                    .astype(jnp.int64) * batch.capacity
+            return [a.func.update(batch, sel, row_base=base)
+                    if a.func.uses_row_base else a.func.update(batch, sel)
+                    for a in self.agg_exprs]
+        return [a.func.update(batch, sel) for a in self.agg_exprs]
+
     def direct_update_tables(self, tables, batch: Batch,
-                             prep: "DirectAggPlan", conf=None):
+                             prep: "DirectAggPlan", conf=None,
+                             row_base=None):
         sel = batch.selection
         key_vecs = [g.eval(batch) for g in self.group_exprs]
         idx, _, _ = agg_kernels.direct_index(key_vecs, prep.domains,
                                              prep.spans, sel)
-        contribs = [a.func.update(batch, sel) for a in self.agg_exprs]
+        contribs = self._updates(batch, sel, row_base=row_base)
         mode = str(conf.get("spark_tpu.sql.aggregate.kernelMode")) \
             if conf is not None else "auto"
         return agg_kernels.direct_update(tables, idx, prep.total, contribs,

@@ -293,12 +293,16 @@ class StreamingQuery:
                 "integer group domain (e.g. pmod keys)")
         self._prep = prep
 
-        def update(tables, b):
+        def update(tables, b, row_base):
             ctx = ExecContext(self.session.conf)
             for op in reversed(self._chain):
                 b = op.compute(ctx, [b])
+            # row_base = the trigger's stream offset: packed First/Last
+            # positions stay globally unique across triggers (and exact
+            # replays of a logged range reuse the same base, keeping
+            # recovery idempotent)
             return self._agg_exec.direct_update_tables(
-                tables, b, prep, self.session.conf)
+                tables, b, prep, self.session.conf, row_base=row_base)
 
         # one jitted step per trigger (no donation: a save failure must
         # leave the PRE-update tables alive for an exact replay)
@@ -376,7 +380,16 @@ class StreamingQuery:
             self._tables = self._agg_exec.direct_init_tables(self._prep)
         new_tables = self._tables
         if table.num_rows:
-            new_tables = self._update(self._tables, self._batch_for(table))
+            b = self._batch_for(table)
+            if start + b.capacity >= (1 << 30) and any(
+                    a.func.uses_row_base
+                    for a in self._agg_exec.agg_exprs):
+                raise RuntimeError(
+                    "first/last over a stream exceeds the 2^30 "
+                    "packed-position bound")
+            import jax.numpy as jnp
+            new_tables = self._update(self._tables, b,
+                                      jnp.asarray(start, jnp.int64))
         # persist BEFORE adopting: a save failure must leave the
         # pre-update tables in place so an in-process retry replays the
         # same range without double-counting

@@ -217,3 +217,60 @@ def test_prune_columns_preserves_join_renames(session):
                  left_on=col("k"), right_on=col("k"))
            .select(col("x_r_r")).to_pandas())
     assert got["x_r_r"].tolist() == [7, 8]
+
+
+def test_first_merge_does_not_fabricate_values():
+    """Round-4 ADVICE high: First packs (pos<<33|isnull<<32|word) per
+    32-bit word under independent min reduces; when two merged updates
+    tie on in-chunk position, the two word accumulators of a 64-bit
+    value could each pick a DIFFERENT row — e.g. merging (2<<32)|1 and
+    (1<<32)|5 at the same position returned (1<<32)|1, a value present
+    in no input row. Globally unique row bases must make one genuine
+    row win all words."""
+    import jax.numpy as jnp
+    from spark_tpu.columnar import Batch, Column
+    from spark_tpu.expr import ColumnRef
+    from spark_tpu.expr_agg import First
+    import spark_tpu.types as T
+
+    v1, v2 = (2 << 32) | 1, (1 << 32) | 5
+    f = First(ColumnRef("x"))
+
+    def one_row(v):
+        return Batch({"x": Column(jnp.asarray([v], jnp.int64), T.LONG)},
+                     jnp.asarray([True]))
+
+    schema = one_row(v1).schema()
+    u1 = f.update(one_row(v1), None, row_base=0)
+    u2 = f.update(one_row(v2), None, row_base=1)  # a later chunk
+    merged = [np.minimum(np.asarray(a), np.asarray(b))
+              for a, b in zip(u1[:-1], u2[:-1])]
+    merged.append(np.asarray(u1[-1]) + np.asarray(u2[-1]))
+    val, valid = f.finalize(merged, schema)
+    assert bool(valid[0])
+    assert int(val[0]) == v1  # the smaller global position, verbatim
+
+
+def test_first_mesh_merge_picks_genuine_rows(session):
+    """End-to-end on the 8-device mesh: the partial/final split merges
+    per-shard First accumulators whose in-shard positions all restart at
+    0 — without globally unique row bases the final min-merge combined
+    shard 0's low word with shard 1's high word, returning 4294967297
+    ((1<<32)|1), a value present in no input row."""
+    mesh_key = "spark_tpu.sql.mesh.size"
+    v1, v2 = (2 << 32) | 1, (1 << 32) | 5
+    n = 4096
+    x = np.full(n, v2, np.int64)
+    x[:512] = v1  # shard 0 holds the v1 rows; shards 1..7 hold v2
+    pdf = pd.DataFrame({"k": np.zeros(n, np.int64), "x": x})
+    session.register_table("first_mesh", pdf)
+    try:
+        session.conf.set(mesh_key, 8)
+        out = (session.table("first_mesh").group_by(col("k"))
+               .agg(F.first(col("x")).alias("f"),
+                    F.last(col("x")).alias("l"))
+               .to_pandas())
+    finally:
+        session.conf.set(mesh_key, 0)
+    assert int(out["f"][0]) in (v1, v2)
+    assert int(out["l"][0]) in (v1, v2)

@@ -270,3 +270,36 @@ def test_new_aggs_mesh_parity(session):
     assert np.allclose(got["cv"], want["cv"])
     assert got["bo"].tolist() == want["bo"].tolist()
     assert got["ci"].tolist() == want["ci"].tolist()
+
+
+def test_advisor_round4_fn_semantics(session):
+    """Round-4 ADVICE: bit_count sign-extends to 64 bits (Long.bitCount),
+    NaN orders as the largest double in greatest/least, and make_date
+    NULLs invalid calendar dates instead of rolling them over."""
+    pdf = pd.DataFrame({
+        "i": np.array([-1, 1, -2], dtype=np.int32),
+        # sqrt of a negative makes a true device NaN (pandas-NaN would
+        # ingest as NULL, which greatest/least legitimately skip)
+        "f": np.array([-1.0, 1.0, -1.0]),
+        "g": np.array([4.0, -1.0, -1.0]),
+        "y": np.array([2023, 2023, 2024], dtype=np.int32),
+        "m": np.array([2, 2, 2], dtype=np.int32),
+        "d": np.array([30, 28, 29], dtype=np.int32)})
+    df = session.create_dataframe(pdf, "adv4_fns")
+    out = df.select(
+        F.bit_count(col("i")).alias("bc"),
+        F.greatest(F.sqrt(col("f")), F.sqrt(col("g"))).alias("gr"),
+        F.least(F.sqrt(col("f")), F.sqrt(col("g"))).alias("le"),
+        F.make_date(col("y"), col("m"), col("d")).alias("md"),
+    ).to_pandas()
+    # -1 as int sign-extends to 64 set bits; -2 to 63
+    assert out["bc"].tolist() == [64, 1, 63]
+    # NaN is the largest double: greatest prefers it, least avoids it
+    assert np.isnan(out["gr"][0]) and np.isnan(out["gr"][1]) \
+        and np.isnan(out["gr"][2])
+    assert out["le"][0] == 2.0 and out["le"][1] == 1.0 \
+        and np.isnan(out["le"][2])
+    # 2023-02-30 is invalid -> NULL; 2023-02-28 and 2024-02-29 are real
+    assert pd.isna(out["md"][0])
+    assert str(out["md"][1])[:10] == "2023-02-28"
+    assert str(out["md"][2])[:10] == "2024-02-29"
