@@ -56,11 +56,13 @@ class Column:
     prov is NOT part of the pytree, so it never crosses a jit boundary
     (dropping it is always sound: `data` stays eagerly defined)."""
 
-    __slots__ = ("data", "validity", "dtype", "dictionary", "prov", "bits")
+    __slots__ = ("data", "validity", "dtype", "dictionary", "prov", "bits",
+                 "offsets", "elem_validity")
 
     def __init__(self, data, dtype: T.DataType, validity=None,
                  dictionary: Optional[pa.Array] = None, prov=None,
-                 bits: Optional[int] = None):
+                 bits: Optional[int] = None, offsets=None,
+                 elem_validity=None):
         self.data = data
         self.dtype = dtype
         self.validity = validity  # None means all-valid
@@ -69,9 +71,17 @@ class Column:
         # optional static value bound: values in [0, 2^bits) — lets
         # int64 arithmetic take single-pass f64 fast paths (see Vec.bits)
         self.bits = bits
+        # ARRAY columns (T.ArrayType): `data` holds the FLATTENED
+        # elements, `offsets` (int32 [rows+1]) marks each row's slice,
+        # `elem_validity` is the per-ELEMENT null mask (`validity` stays
+        # per-row) — the Arrow List layout (UnsafeArrayData.java:1 seat)
+        self.offsets = offsets
+        self.elem_validity = elem_validity
 
     @property
     def capacity(self) -> int:
+        if self.offsets is not None:
+            return self.offsets.shape[0] - 1
         return self.data.shape[0]
 
     def with_data(self, data, validity="__keep__") -> "Column":
@@ -85,18 +95,27 @@ class Column:
 
 
 def _col_flatten(c: Column):
-    if c.validity is None:
-        return (c.data,), (False, c.dtype, c.dictionary)
-    return (c.data, c.validity), (True, c.dtype, c.dictionary)
+    children = [c.data]
+    flags = [c.validity is not None, c.offsets is not None,
+             c.elem_validity is not None]
+    if flags[0]:
+        children.append(c.validity)
+    if flags[1]:
+        children.append(c.offsets)
+    if flags[2]:
+        children.append(c.elem_validity)
+    return tuple(children), (tuple(flags), c.dtype, c.dictionary)
 
 
 def _col_unflatten(aux, children):
-    has_validity, dtype, dictionary = aux
-    if has_validity:
-        data, validity = children
-    else:
-        (data,), validity = children, None
-    return Column(data, dtype, validity, dictionary)
+    flags, dtype, dictionary = aux
+    it = iter(children)
+    data = next(it)
+    validity = next(it) if flags[0] else None
+    offsets = next(it) if flags[1] else None
+    elem_validity = next(it) if flags[2] else None
+    return Column(data, dtype, validity, dictionary, offsets=offsets,
+                  elem_validity=elem_validity)
 
 
 jax.tree_util.register_pytree_node(Column, _col_flatten, _col_unflatten)
@@ -211,6 +230,10 @@ class Batch:
             pulls.append(col.data)
             if col.validity is not None:
                 pulls.append(col.validity)
+            if col.offsets is not None:
+                pulls.append(col.offsets)
+            if col.elem_validity is not None:
+                pulls.append(col.elem_validity)
         host = iter(jax.device_get(pulls))
         sel = next(host) if self.selection is not None else None
         arrays = []
@@ -218,6 +241,13 @@ class Batch:
         for name, col in self.columns.items():
             data = next(host)
             valid = next(host) if col.validity is not None else None
+            offsets = next(host) if col.offsets is not None else None
+            evalid = next(host) if col.elem_validity is not None else None
+            if offsets is not None:
+                arrays.append(_list_to_arrow(col, data, valid, offsets,
+                                             evalid, sel))
+                names.append(name)
+                continue
             if sel is not None:
                 data = data[sel]
                 if valid is not None:
@@ -362,6 +392,8 @@ def _np_to_dtype(np_dtype) -> T.DataType:
 def _arrow_to_column(name: str, col: pa.ChunkedArray, n: int, cap: int) -> Column:
     arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
     at = arr.type
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return _arrow_list_to_column(name, arr, n, cap)
     dictionary = None
     if pa.types.is_string(at) or pa.types.is_large_string(at):
         arr = arr.dictionary_encode()
@@ -422,6 +454,59 @@ def _arrow_to_column(name: str, col: pa.ChunkedArray, n: int, cap: int) -> Colum
     padded[:n] = np_data
     # device_put is ~2x jnp.asarray for host->device of large buffers
     return Column(jax.device_put(padded), dt, validity, dictionary)
+
+
+def _arrow_list_to_column(name: str, arr, n: int, cap: int) -> Column:
+    """pa.ListArray -> offsets-encoded list Column: FLATTENED element
+    data + absolute int32 offsets [cap+1] (padding rows repeat the last
+    offset, i.e. zero-length)."""
+    if pa.types.is_large_list(arr.type):
+        arr = arr.cast(pa.list_(arr.type.value_type))
+    offs = arr.offsets.to_numpy(zero_copy_only=False).astype(np.int32)
+    values = arr.values
+    vcap = bucket_capacity(max(len(values), 1))
+    elem = _arrow_to_column(f"{name}.element", values, len(values), vcap)
+    padded_off = np.full(cap + 1, offs[n] if len(offs) > n else 0,
+                         dtype=np.int32)
+    padded_off[:n + 1] = offs[:n + 1]
+    validity = None
+    if arr.null_count > 0:
+        valid_np = np.zeros(cap, dtype=np.bool_)
+        valid_np[:n] = ~np.asarray(arr.is_null())
+        validity = jax.device_put(valid_np)
+    return Column(elem.data, T.ArrayType(elem.dtype), validity,
+                  elem.dictionary, offsets=jax.device_put(padded_off),
+                  elem_validity=elem.validity)
+
+
+def _list_to_arrow(col: Column, data: np.ndarray,
+                   valid: Optional[np.ndarray], offsets: np.ndarray,
+                   elem_valid: Optional[np.ndarray],
+                   sel: Optional[np.ndarray]) -> pa.Array:
+    """Offsets-encoded list column -> pa.ListArray over the SELECTED
+    rows (compaction happens here — per-row slices can't be gathered by
+    the flat-column path)."""
+    cap = len(offsets) - 1
+    idx = np.nonzero(sel[:cap])[0] if sel is not None else np.arange(cap)
+    starts = offsets[idx]
+    lengths = (offsets[idx + 1] - starts).astype(np.int64)
+    lengths = np.maximum(lengths, 0)
+    new_off = np.zeros(len(idx) + 1, dtype=np.int32)
+    np.cumsum(lengths, out=new_off[1:])
+    total = int(new_off[-1])
+    intra = np.arange(total, dtype=np.int64) - np.repeat(
+        new_off[:-1].astype(np.int64), lengths)
+    val_idx = np.repeat(starts.astype(np.int64), lengths) + intra
+    vals = data[val_idx]
+    ev = None if elem_valid is None else elem_valid[val_idx]
+    elem_col = Column(None, col.dtype.element, None, col.dictionary)
+    elem_arrow = _column_to_arrow(elem_col, vals, ev)
+    off_mask = None
+    if valid is not None:
+        off_mask = np.zeros(len(idx) + 1, dtype=bool)
+        off_mask[:len(idx)] = ~valid[idx]
+    return pa.ListArray.from_arrays(
+        pa.array(new_off, type=pa.int32(), mask=off_mask), elem_arrow)
 
 
 def _column_to_arrow(col: Column, data: np.ndarray,

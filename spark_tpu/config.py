@@ -141,6 +141,24 @@ STREAMING_CHUNK_ROWS = register(
         "the way the reference's row-iterator pipeline does. (1<<26 "
         "chunks faulted the v5e runtime on wide-domain aggregates.)")
 
+WAREHOUSE_DIR = register(
+    "spark_tpu.sql.warehouse.dir", "spark-warehouse",
+    doc="Directory for persistent tables (CREATE TABLE / INSERT INTO): "
+        "one subdirectory of parquet parts + a JSON metadata sidecar per "
+        "table. The metastore seat of SessionCatalog.scala:1, minus the "
+        "Hive process: a fresh session over the same dir sees every "
+        "table.")
+
+DEVICE_MEMORY_BUDGET = register(
+    "spark_tpu.sql.memory.deviceBudget", 0,
+    doc="Device (HBM) byte budget for a single query's resident working "
+        "set. Scans whose estimated post-prune footprint exceeds it are "
+        "executed out-of-core: chunked through device-resident build "
+        "sides with partial-aggregate spill to host Arrow buffers (the "
+        "UnsafeExternalSorter.java / ExternalAppendOnlyMap.scala:55 "
+        "analog — host RAM plays the role of executor disk). 0 = "
+        "unbounded (whole-input residency).")
+
 DEVICE_CACHE_BYTES = register(
     "spark_tpu.sql.io.deviceCacheBytes", 6 << 30,
     doc="Byte budget for the device-resident table cache: loaded scans "

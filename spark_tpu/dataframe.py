@@ -36,6 +36,9 @@ class DataFrame:
     def select(self, *exprs) -> "DataFrame":
         es = [_expr(e) for e in exprs]
         plan, es = self._extract_windows(es)
+        from .expr_array import contains_explode, extract_generators
+        if any(contains_explode(e) for e in es):
+            plan, es = extract_generators(plan, es)
         return self._with(L.Project(plan, es))
 
     def _extract_windows(self, exprs: List[Expression]):
@@ -410,3 +413,47 @@ class GroupedData:
 
     def count(self) -> DataFrame:
         return self.agg(AggExpr(Count(None), "count"))
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """Grouped-map pandas UDF: ``fn(pdf) -> pdf`` per key group
+        (reference: FlatMapGroupsInPandasExec over Arrow batches,
+        `ArrowEvalPythonExec.scala:1` family). The input materializes
+        host-side — the same stage cut the reference makes, minus the
+        worker sockets. `schema` is "name type, ..." or a T.Schema."""
+        import pandas as pd
+        from . import types as T
+        from .udf import _parse_return_type
+
+        if isinstance(schema, str):
+            fields = []
+            for part in schema.split(","):
+                name, typ = part.strip().rsplit(" ", 1)
+                fields.append(T.Field(name.strip(),
+                                      _parse_return_type(typ), True))
+            out_schema = T.Schema(fields)
+        else:
+            out_schema = schema
+        key_names = [g.name() for g in self._groups]
+        pdf = self._df.select(
+            *([*self._groups] + [ColumnRef(n)
+                                 for n in self._df.plan.schema().names
+                                 if n not in {g.name()
+                                              for g in self._groups}])
+        ).to_pandas() if self._groups else self._df.to_pandas()
+        if key_names:
+            pieces = [fn(g.reset_index(drop=True))
+                      for _, g in pdf.groupby(key_names, sort=False,
+                                              dropna=False)]
+        else:
+            pieces = [fn(pdf)]
+        out = pd.concat(pieces, ignore_index=True) if pieces else \
+            pd.DataFrame({f.name: [] for f in out_schema.fields})
+        out = out[[f.name for f in out_schema.fields]]
+        for f in out_schema.fields:  # pin declared dtypes
+            if not isinstance(f.dtype, (T.StringType, T.DateType)):
+                out[f.name] = out[f.name].astype(f.dtype.np_dtype)
+        # a plain in-memory scan — never registered, so the session
+        # catalog stays free of internal temp tables
+        return self._df.session.create_dataframe(out, "__grouped_map__")
+
+    applyInPandas = apply_in_pandas

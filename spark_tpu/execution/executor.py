@@ -36,6 +36,7 @@ class QueryExecution:
         self._executed: Optional[P.PhysicalPlan] = None
         self.phase_times: Dict[str, float] = {}
         self.last_metrics: Dict[str, int] = {}
+        self.spilled_partial_rows: Optional[int] = None
 
     def _activate_conf(self) -> None:
         """Apply session conf to analysis-time globals (the reference's
@@ -179,12 +180,34 @@ class QueryExecution:
         mesh, PARTIAL aggregates over chunked scans stream with per-shard
         tables (the exchange + final stages above run unchanged)."""
         from .streaming_agg import (stream_scan_aggregate_mesh,
-                                    try_stream_aggregate)
+                                    try_stream_aggregate,
+                                    try_stream_aggregate_spill)
         if mesh is None and isinstance(node, P.HashAggregateExec):
             result = try_stream_aggregate(node, self.session.conf,
                                           self.session._stage_cache)
             if result is not None:
                 return P.InputExec(result, node.schema(), label="streamed_agg")
+            spill = try_stream_aggregate_spill(node, self.session.conf,
+                                               self.session._stage_cache)
+            if spill is not None:
+                # out-of-core: host-spilled partials re-reduce in a
+                # FINAL aggregate (the partial -> exchange -> final
+                # split of AggUtils.scala, with host Arrow buffers in
+                # the exchange's seat)
+                from ..expr import ColumnRef
+                partial_table, partial_node = spill
+                inp = P.InputExec(Batch.from_arrow(partial_table),
+                                  partial_node.schema(),
+                                  label="spilled_partials")
+                inp._agg_base_schema = node._base_schema()
+                final_groups = [ColumnRef(g.name())
+                                for g in node.group_exprs]
+                final = P.HashAggregateExec(
+                    inp, final_groups, node.agg_exprs, mode="final",
+                    est_groups=max(partial_table.num_rows, 8))
+                final.tag = node.tag
+                self.spilled_partial_rows = partial_table.num_rows
+                return final
         if mesh is not None and isinstance(node, P.HashAggregateExec) \
                 and node.mode == "partial":
             result = stream_scan_aggregate_mesh(
@@ -202,6 +225,25 @@ class QueryExecution:
             import copy
             node = copy.copy(node)
             node.children = new_children
+        return node
+
+    def _materialize_generates(self, node: P.PhysicalPlan
+                               ) -> P.PhysicalPlan:
+        """Mesh runs: offsets-encoded list columns cannot shard (their
+        offsets are absolute into the flattened values), so explode
+        subtrees materialize single-device and the FLAT exploded result
+        shards as an InputExec — the stage cut the reference makes at
+        GenerateExec.scala:1, with the generate on the driver device."""
+        new_children = tuple(self._materialize_generates(c)
+                             for c in node.children)
+        if new_children != node.children:
+            import copy
+            node = copy.copy(node)
+            node.children = new_children
+        if isinstance(node, P.GenerateExec):
+            from .streaming_agg import _materialize_subtree
+            b = _materialize_subtree(node, self.session.conf)
+            return P.InputExec(b, node.schema(), label="generated")
         return node
 
     def _compile_stage(self, root: P.PhysicalPlan, mesh=None):
@@ -393,10 +435,18 @@ class QueryExecution:
             if aqe_key is not None else None
         if saved_caps:
             self._apply_saved_caps(self.executed_plan, saved_caps)
+        root0 = self.executed_plan
+        from .python_eval import extract_python_udfs, plan_has_udfs
+        if plan_has_udfs(root0):
+            t0 = time.perf_counter()
+            root0 = extract_python_udfs(root0, self.session.conf)
+            self.phase_times["python_udfs"] = time.perf_counter() - t0
+        if mesh is not None:
+            root0 = self._materialize_generates(root0)
         t0 = time.perf_counter()
-        root = self._materialize_streaming(self.executed_plan, mesh)
+        root = self._materialize_streaming(root0, mesh)
         dt = time.perf_counter() - t0
-        if root is not self.executed_plan:
+        if root is not root0:
             # chunked ingest + chunk compute happen inside the splice
             self.phase_times["streaming"] = dt
         scans: List[P.LeafExec] = []
@@ -525,5 +575,29 @@ class QueryExecution:
             warnings.warn(f"event log write failed: {e}")
 
     def collect(self) -> pa.Table:
+        ext = self._try_external_collect()
+        if ext is not None:
+            return ext
         batch, _, _ = self.execute_batch()
         return batch.to_arrow()
+
+    def _try_external_collect(self) -> Optional[pa.Table]:
+        """Out-of-core host egress (execution/external.py): ORDER BY /
+        LIMIT / plain materialization over scans past the deviceBudget
+        stream chunk-wise and spill to host Arrow — never resident."""
+        budget = int(self.session.conf.get(
+            "spark_tpu.sql.memory.deviceBudget"))
+        if budget <= 0:
+            return None
+        from .external import try_external_collect
+        from .python_eval import plan_has_udfs
+        self._activate_conf()
+        if plan_has_udfs(self.executed_plan):
+            return None  # UDF stages evaluate through execute_batch
+        t0 = time.perf_counter()
+        out = try_external_collect(self.session, self.executed_plan,
+                                   self.session.conf,
+                                   self.session._stage_cache)
+        if out is not None:
+            self.phase_times["external"] = time.perf_counter() - t0
+        return out

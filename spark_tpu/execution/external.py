@@ -1,0 +1,182 @@
+"""Out-of-core host-egress execution: ORDER BY / LIMIT / plain
+materialization over scans that exceed the device memory budget.
+
+The reference handles over-memory sorts and materializations with
+spillable operators on executor disk (`UnsafeExternalSorter.java:1`,
+`ExternalAppendOnlyMap.scala:55`, `SortExec.scala:40`). The TPU-native
+inversion: chunks of the probe scan stream through the jitted
+filter/project/join chain on device, and the HOST (RAM + Arrow buffers)
+plays the spill tier:
+
+- ``LIMIT n``      -> stream chunks until n live rows have spilled;
+- ``ORDER BY + LIMIT`` -> per-chunk device top-n (sort+limit fused into
+  the chunk program), then one final device sort+limit over the
+  concatenated (n_chunks x n, small) spill — a tournament reduction;
+- ``ORDER BY``     -> spill every replayed chunk, then one host-side
+  pyarrow sort over the spilled runs (the k-way-merge seat; order keys
+  must be output columns) honoring ASC/DESC + NULLS FIRST/LAST;
+- plain chain      -> spill every replayed chunk and concatenate.
+
+Engages only past ``spark_tpu.sql.memory.deviceBudget`` (config.py), so
+in-budget queries keep whole-input residency and device sorts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..columnar import Batch, bucket_capacity
+from ..plan import physical as P
+from .streaming_agg import (CHUNK_ROWS_KEY, _CHUNKABLE_JOINS,
+                            _replay_chain, apply_join_overflow,
+                            prepare_chunk_joins)
+
+
+def _match_shape(plan: P.PhysicalPlan):
+    """[LimitExec] [SortExec] (Project|Filter|chunkable Join)* Scan."""
+    limit = None
+    sort = None
+    node = plan
+    if isinstance(node, P.LimitExec):
+        limit = node
+        node = node.child
+    if isinstance(node, P.SortExec):
+        sort = node
+        node = node.child
+    chain: List[P.PhysicalPlan] = []
+    while True:
+        if isinstance(node, (P.ProjectExec, P.FilterExec)):
+            chain.append(node)
+            node = node.children[0]
+        elif isinstance(node, P.JoinExec) and node.how in _CHUNKABLE_JOINS:
+            chain.append(node)
+            node = node.children[0]
+        else:
+            break
+    if not isinstance(node, P.ScanExec):
+        return None
+    return limit, sort, chain, node
+
+
+def _host_sort_keys(sort: P.SortExec, schema) -> Optional[List[Tuple]]:
+    """SortOrders -> pyarrow (name, order, null_placement) keys, or
+    None when any key is a computed expression (host merge needs the key
+    as a spilled output column)."""
+    from ..expr import Alias, ColumnRef
+    keys = []
+    names = set(schema.names)
+    for o in sort.orders:
+        e = o.child
+        while isinstance(e, Alias):
+            e = e.child
+        if not isinstance(e, ColumnRef) or e._name not in names:
+            return None
+        keys.append((e._name,
+                     "ascending" if o.ascending else "descending",
+                     "at_start" if o.nulls_first else "at_end"))
+    return keys
+
+
+def try_external_collect(session, plan: P.PhysicalPlan, conf,
+                         cache: Optional[dict] = None
+                         ) -> Optional[pa.Table]:
+    budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
+    if budget <= 0:
+        return None
+    from ..parallel.mesh import get_mesh
+    if get_mesh(conf) is not None:
+        return None  # the mesh streaming drivers own distributed runs
+    m = _match_shape(plan)
+    if m is None:
+        return None
+    limit, sort, chain, leaf = m
+    if not hasattr(leaf.source, "load_chunks"):
+        return None
+    from ..io.device_cache import estimated_scan_bytes
+    est_b = estimated_scan_bytes(leaf)
+    if est_b is not None and est_b <= budget:
+        return None
+
+    # pure ORDER BY (no limit) merges on host: keys must be columns
+    host_keys = None
+    if sort is not None and limit is None:
+        host_keys = _host_sort_keys(sort, plan.schema())
+        if host_keys is None:
+            return None
+
+    chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
+    chunks = leaf.source.load_chunks(leaf.required_columns,
+                                     leaf.pushed_filters, chunk_rows)
+    first = next(iter(chunks), None)
+    if first is None:
+        return None
+
+    joins, builds, _saved = prepare_chunk_joins(
+        chain, conf, first.capacity)
+
+    topn = sort is not None and limit is not None
+
+    def make_update():
+        key = (f"ext_collect:{plan.describe()}:{chunk_rows}")
+        fn = cache.get(key) if cache is not None else None
+        if fn is None:
+            def update(b, bb):
+                ctx = P.ExecContext(conf)
+                b = _replay_chain(chain, ctx, b, bb)
+                if topn:
+                    # fuse the chunk's top-n into the device program:
+                    # sorting compacts the selection, limit masks to n
+                    b = sort.compute(ctx, [b])
+                    b = limit.compute(ctx, [b])
+                return b, ctx.flags, ctx.metrics
+
+            fn = jax.jit(update)
+            if cache is not None:
+                cache[key] = fn
+        return fn
+
+    update_fn = make_update()
+
+    def run_chunk(b):
+        nonlocal update_fn
+        for _attempt in range(8):
+            out, flags, metrics = update_fn(b, builds)
+            flags, metrics = jax.device_get((flags, metrics))
+            if not apply_join_overflow(flags, metrics, joins):
+                return out
+            # describe() changed with the grown caps: re-jit, retry
+            update_fn = make_update()
+        raise RuntimeError("external-collect join capacity did not "
+                           "converge")
+
+    import itertools
+    spilled: List[pa.Table] = []
+    total_rows = 0
+    for b in itertools.chain([first], chunks):
+        t = run_chunk(b).to_arrow()
+        spilled.append(t)
+        total_rows += t.num_rows
+        if limit is not None and sort is None and total_rows >= limit.n:
+            break  # plain LIMIT: enough live rows spilled
+
+    table = pa.concat_tables(spilled, promote_options="permissive")
+
+    if topn:
+        # tournament final: one small device sort+limit over the
+        # concatenated per-chunk top-n spills
+        ctx = P.ExecContext(conf)
+        b = Batch.from_arrow(table)
+        b = sort.compute(ctx, [b])
+        b = limit.compute(ctx, [b])
+        return b.to_arrow()
+    if sort is not None:
+        idx = pc.sort_indices(
+            table, options=pc.SortOptions(sort_keys=host_keys))
+        return table.take(idx)
+    if limit is not None:
+        return table.slice(0, limit.n)
+    return table

@@ -1,0 +1,169 @@
+"""ExtractPythonUDFs: cut the jitted plan at Python UDF call sites.
+
+The reference pulls PythonUDF expressions out of projections/filters
+into BatchEvalPythonExec / ArrowEvalPythonExec stages that stream Arrow
+batches to worker processes (`ExtractPythonUDFs.scala`,
+`ArrowEvalPythonExec.scala:1`). Here the executor materializes the UDF
+node's child subtree (one stage), evaluates the functions host-side over
+the compacted Arrow table, and splices the results back as an InputExec
+with appended ``__udf_i`` columns — the surrounding plan stays jitted.
+"""
+
+from __future__ import annotations
+
+import copy
+import decimal as _decimal
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..columnar import Batch
+from ..expr import Alias, ColumnRef
+from ..plan import physical as P
+from ..udf import PythonUDF, evaluate_udf, result_to_arrow
+
+EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def _collect_udfs(e, out: List[PythonUDF]):
+    """Collect INNERMOST-first extractable call sites: a UDF whose args
+    contain another UDF waits for the next extraction pass (its args
+    must resolve to already-spliced ``__udf_i`` columns first)."""
+    if isinstance(e, PythonUDF):
+        inner: List[PythonUDF] = []
+        for c in e.children:
+            _collect_udfs(c, inner)
+        out.extend(inner if inner else [e])
+        return
+    for c in e.children:
+        _collect_udfs(c, out)
+
+
+def node_udfs(node: P.PhysicalPlan) -> List[PythonUDF]:
+    out: List[PythonUDF] = []
+    if isinstance(node, P.ProjectExec):
+        for e in node.exprs:
+            _collect_udfs(e, out)
+    elif isinstance(node, P.FilterExec):
+        _collect_udfs(node.condition, out)
+    elif isinstance(node, P.HashAggregateExec) and node.mode != "final":
+        # UDFs in group keys or aggregate arguments (group_by(udf(x)),
+        # sum(udf(x)) — incl. projections the optimizer collapsed in).
+        # FINAL-mode aggregates merge accumulator columns and never
+        # evaluate their function children, so they are left alone.
+        for g in node.group_exprs:
+            _collect_udfs(g, out)
+        for a in node.agg_exprs:
+            for c in a.func.children:
+                _collect_udfs(c, out)
+    return out
+
+
+def plan_has_udfs(root: P.PhysicalPlan) -> bool:
+    if node_udfs(root):
+        return True
+    return any(plan_has_udfs(c) for c in root.children)
+
+
+def _vec_to_host(vec, n_rows: int):
+    """Device Vec -> (python-friendly host array, validity|None) over a
+    fully-live (compacted) batch."""
+    import jax
+    if vec.validity is not None:
+        data, valid = jax.device_get((vec.data, vec.validity))
+        valid = np.asarray(valid[:n_rows])
+    else:
+        data, valid = jax.device_get(vec.data), None
+    data = np.asarray(data[:n_rows])
+    if vec.dictionary is not None:
+        values = np.asarray(vec.dictionary.to_pandas(), dtype=object)
+        codes = np.clip(data, 0, len(values) - 1)
+        data = values[codes] if len(values) else \
+            np.full(n_rows, None, dtype=object)
+    elif isinstance(vec.dtype, T.DateType):
+        data = (EPOCH + data.astype("timedelta64[D]")).astype(object)
+    elif isinstance(vec.dtype, T.TimestampType):
+        data = data.astype("datetime64[us]").astype(object)
+    elif isinstance(vec.dtype, T.DecimalType):
+        q = _decimal.Decimal(1).scaleb(-vec.dtype.scale)
+        data = np.array([_decimal.Decimal(int(x)) * q for x in data],
+                        dtype=object)
+    return data, valid
+
+
+def _eval_udfs_host(udfs: List[PythonUDF], batch: Batch,
+                    table: pa.Table, base: int) -> pa.Table:
+    """Append one ``__udf_i`` column per call site to the host table."""
+    n = table.num_rows
+    for i, u in enumerate(udfs, start=base):
+        arg_arrays, arg_valids = [], []
+        for a in u.children:
+            vec = a.eval(batch)  # eager device eval of the arg exprs
+            data, valid = _vec_to_host(vec, n)
+            arg_arrays.append(data)
+            arg_valids.append(valid)
+        values, valid = evaluate_udf(u, arg_arrays, arg_valids, n)
+        table = table.append_column(f"__udf_{i}", result_to_arrow(
+            u, values, valid))
+    return table
+
+
+def _rewrite(e, udfs: List[PythonUDF], base: int, top_level: bool):
+    """Replace PythonUDF call sites with refs to their ``__udf_i``
+    columns (identity-matched: the same call site object evaluates
+    once)."""
+    for i, u in enumerate(udfs, start=base):
+        if e is u:
+            ref = ColumnRef(f"__udf_{i}")
+            # a bare top-level UDF projects under its pretty name
+            return Alias(ref, e.name()) if top_level else ref
+    return e.map_children(lambda c: _rewrite(c, udfs, base, False))
+
+
+def _agg_rewrite(a, udfs: List[PythonUDF], base: int):
+    na = copy.copy(a)
+    na.func = a.func.with_args(
+        [_rewrite(c, udfs, base, False) for c in a.func.children])
+    return na
+
+
+def extract_python_udfs(root: P.PhysicalPlan, conf) -> P.PhysicalPlan:
+    """Bottom-up: materialize each UDF-bearing node's child, evaluate
+    the UDFs on host, splice an InputExec (child cols + __udf cols),
+    and rewrite the node's expressions over it."""
+    new_children = tuple(extract_python_udfs(c, conf)
+                         for c in root.children)
+    if new_children != root.children:
+        root = copy.copy(root)
+        root.children = new_children
+    from .streaming_agg import _materialize_subtree
+    node = root
+    # nested calls (udf(udf(x))) extract one layer per iteration
+    for _depth in range(16):
+        udfs = node_udfs(node)
+        if not udfs:
+            return node
+        child = node.children[0]
+        b = _materialize_subtree(child, conf)
+        table = b.to_arrow()                      # compact live rows
+        cb = Batch.from_arrow(table)              # fully-live device batch
+        base = sum(1 for n_ in table.column_names
+                   if n_.startswith("__udf_"))
+        table = _eval_udfs_host(udfs, cb, table, base)
+        nb = Batch.from_arrow(table)
+        inp = P.InputExec(nb, nb.schema(), label="python_udf")
+        node = copy.copy(node)
+        node.children = (inp,)
+        if isinstance(node, P.ProjectExec):
+            node.exprs = tuple(_rewrite(e, udfs, base, True)
+                               for e in node.exprs)
+        elif isinstance(node, P.FilterExec):
+            node.condition = _rewrite(node.condition, udfs, base, False)
+        else:
+            node.group_exprs = tuple(_rewrite(g, udfs, base, True)
+                                     for g in node.group_exprs)
+            node.agg_exprs = tuple(
+                _agg_rewrite(a, udfs, base) for a in node.agg_exprs)
+    raise RuntimeError("python UDF nesting did not resolve in 16 passes")

@@ -87,8 +87,39 @@ def sort_permutation(batch: Batch, orders: Sequence[SortOrder]):
 def apply_permutation(batch: Batch, perm, n_valid) -> Batch:
     cols = {}
     for name, col in batch.columns.items():
+        if col.offsets is not None:
+            cols[name] = _permute_list_column(col, perm)
+            continue
         data = jnp.take(col.data, perm)
         validity = None if col.validity is None else jnp.take(col.validity, perm)
         cols[name] = Column(data, col.dtype, validity, col.dictionary)
     sel = jnp.arange(batch.capacity) < n_valid
     return Batch(cols, sel)
+
+
+def _permute_list_column(col: Column, perm) -> Column:
+    """Row-permute an offsets-encoded array column: rebuild offsets from
+    the permuted row lengths, then gather each output value slot from
+    its source slice — all static shapes (the flattened values array
+    keeps its capacity), so arrays survive ORDER BY instead of being
+    gathered as garbage scalars (code-review r5)."""
+    old_off = col.offsets
+    starts = jnp.take(old_off[:-1], perm)
+    lengths = jnp.take(old_off[1:] - old_off[:-1], perm)
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), old_off.dtype), jnp.cumsum(lengths)]) \
+        .astype(old_off.dtype)
+    vcap = col.data.shape[0]
+    iota = jnp.arange(vcap, dtype=jnp.int32)
+    out_row = jnp.clip(
+        jnp.searchsorted(new_off, iota, side="right") - 1, 0,
+        len(lengths) - 1)
+    intra = iota - jnp.take(new_off, out_row)
+    src = jnp.clip(jnp.take(starts, out_row) + intra, 0, vcap - 1)
+    data = jnp.take(col.data, src)
+    ev = None if col.elem_validity is None else \
+        jnp.take(col.elem_validity, src)
+    validity = None if col.validity is None else \
+        jnp.take(col.validity, perm)
+    return Column(data, col.dtype, validity, col.dictionary,
+                  offsets=new_off, elem_validity=ev)

@@ -72,6 +72,50 @@ def _replay_chain(chain: List, ctx, batch: Batch,
     return batch
 
 
+def apply_join_overflow(flags, metrics, joins) -> bool:
+    """Parse one chunk update's `join_overflow_`/`join_nonunique_` flag
+    families and apply capacity growth / unique-build fallbacks to
+    `joins`. Returns True when anything changed — the caller must re-jit
+    and retry the SAME chunk against the pre-update state. The ONE copy
+    of the chunked-join AQE protocol, shared by every chunk driver
+    (direct stream, partial spill, external collect)."""
+    overflow = [k for k, v in flags.items()
+                if k.startswith(("join_overflow_", "join_nonunique_"))
+                and bool(v)]
+    if not overflow:
+        return False
+    for k in overflow:
+        if k.startswith("join_nonunique_"):
+            tag = k[len("join_nonunique_"):]
+            for j in joins:
+                if j.tag == tag:
+                    j.unique_build = False
+            continue
+        tag = k[len("join_overflow_"):]
+        total = int(metrics[f"join_rows_{tag}"])
+        for j in joins:
+            if j.tag == tag:
+                j.out_cap = bucket_capacity(max(total, 8))
+    return True
+
+
+def prepare_chunk_joins(chain: List, conf, first_cap: int):
+    """Shared chunk-driver setup: materialize each probe-side join's
+    build subtree once (QueryStageExec role) and seed missing output
+    capacities with the CHUNK capacity. Returns (joins, builds,
+    saved_caps); learned caps stay on the plan nodes afterwards so the
+    AQE cap harvest persists them — callers restore `saved_caps` only
+    when aborting before any chunk ran."""
+    joins = [op for op in chain if isinstance(op, P.JoinExec)]
+    builds = {j.tag: _materialize_subtree(j.children[1], conf)
+              for j in joins}
+    saved_caps = {j.tag: j.out_cap for j in joins}
+    for j in joins:
+        if j.out_cap is None:
+            j.out_cap = first_cap
+    return joins, builds, saved_caps
+
+
 def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
     """Compile + run an independent subtree (a join's build side) with
     its own AQE capacity-retry loop — a stage materialization, like the
@@ -217,17 +261,8 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
     if first is None:
         return None
 
-    joins = [op for op in chain if isinstance(op, P.JoinExec)]
-    # build sides materialize ONCE (independent subtrees — the
-    # QueryStageExec role); per-chunk probes join against them in HBM
-    builds = {j.tag: _materialize_subtree(j.children[1], conf)
-              for j in joins}
-    saved_caps = {j.tag: j.out_cap for j in joins}
-    for j in joins:
-        if j.out_cap is None:
-            # per-chunk output capacity defaults to the CHUNK capacity,
-            # not the whole-scan capacity
-            j.out_cap = first.capacity
+    joins, builds, saved_caps = prepare_chunk_joins(
+        chain, conf, first.capacity)
 
     def make_update():
         key = f"stream_scan:{agg.describe()}:{chunk_rows}"
@@ -298,24 +333,8 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
         for _attempt in range(8):
             new, flags, metrics = update_fn(tables, b, builds, base)
             flags, metrics = jax.device_get((flags, metrics))
-            overflow = [k for k, v in flags.items()
-                        if k.startswith(("join_overflow_",
-                                         "join_nonunique_"))
-                        and bool(v)]
-            if not overflow:
+            if not apply_join_overflow(flags, metrics, joins):
                 return new
-            for k in overflow:
-                if k.startswith("join_nonunique_"):
-                    tag = k[len("join_nonunique_"):]
-                    for j in joins:
-                        if j.tag == tag:
-                            j.unique_build = False
-                    continue
-                tag = k[len("join_overflow_"):]
-                total = int(metrics[f"join_rows_{tag}"])
-                for j in joins:
-                    if j.tag == tag:
-                        j.out_cap = bucket_capacity(max(total, 8))
             # out_cap is part of describe(): re-jit under the new key,
             # then retry the SAME chunk against the pre-update tables
             # (the grown out_cap widens the position stride — re-check)
@@ -334,6 +353,107 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
     dict_overrides = dict(chunks.dictionaries) if hasattr(
         chunks, "dictionaries") else {}
     return agg.direct_finalize_tables(tables, prep, dict_overrides or None)
+
+
+def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
+                                leaf: P.ScanExec, conf,
+                                cache: Optional[dict] = None):
+    """Out-of-core aggregation for UNBOUNDED group keys (no static
+    domain — e.g. TPC-H Q3's l_orderkey): stream probe chunks through
+    device-resident build sides, reduce each chunk with a PARTIAL-mode
+    sort aggregate (num_segments = chunk capacity, so per-chunk overflow
+    is impossible), and spill the compacted partial batches to host
+    Arrow buffers — host RAM plays the role the reference's executor
+    disk plays for `UnsafeExternalSorter.java:1` /
+    `ExternalAppendOnlyMap.scala:55`. Returns (concatenated host partial
+    table, partial node) for the caller to re-reduce with a FINAL
+    aggregate; None when the shape doesn't apply."""
+    import copy
+
+    chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
+    chunks = leaf.source.load_chunks(leaf.required_columns,
+                                     leaf.pushed_filters, chunk_rows)
+    first = next(iter(chunks), None)
+    if first is None:
+        return None
+
+    joins, builds, saved_caps = prepare_chunk_joins(
+        chain, conf, first.capacity)
+
+    partial = copy.copy(agg)
+    partial.mode = "partial"
+    # num_segments falls back to the post-replay batch capacity: a chunk
+    # can never have more groups than rows, so the per-chunk partial
+    # needs no overflow retry of its own
+    partial.est_groups = None
+
+    def make_update():
+        key = f"stream_spill:{agg.describe()}:{chunk_rows}"
+        fn = cache.get(key) if cache is not None else None
+        if fn is None:
+            def update(b, bb):
+                ctx = P.ExecContext(conf)
+                b = _replay_chain(chain, ctx, b, bb)
+                out = partial.compute(ctx, [b])
+                return out, ctx.flags, ctx.metrics
+
+            fn = jax.jit(update)
+            if cache is not None:
+                cache[key] = fn
+        return fn
+
+    update_fn = make_update()
+    spilled: List = []
+
+    def run_chunk(b):
+        nonlocal update_fn
+        for _attempt in range(8):
+            out, flags, metrics = update_fn(b, builds)
+            flags, metrics = jax.device_get((flags, metrics))
+            if not apply_join_overflow(flags, metrics, joins):
+                return out
+            # describe() changed with the grown caps: re-jit and retry
+            # the SAME chunk (partials for it were not yet spilled)
+            update_fn = make_update()
+        raise RuntimeError("spilled join capacity did not converge")
+
+    # spill each chunk's compacted partial to host; dictionary-encoded
+    # group keys decode to strings here, so per-chunk dictionaries unify
+    # value-wise in the concat (no shared-encoding requirement)
+    spilled.append(run_chunk(first).to_arrow())
+    for b in chunks:
+        spilled.append(run_chunk(b).to_arrow())
+    for j in joins:
+        j.out_cap = saved_caps[j.tag] if saved_caps[j.tag] is not None \
+            else j.out_cap
+    import pyarrow as pa
+    table = pa.concat_tables(spilled, promote_options="permissive")
+    return table, partial
+
+
+def try_stream_aggregate_spill(agg: "P.HashAggregateExec", conf,
+                               cache: Optional[dict] = None):
+    """deviceBudget gate for the out-of-core partial-spill path: engages
+    only when the probe scan's estimated footprint exceeds
+    `spark_tpu.sql.memory.deviceBudget` (the planner-consulted memory
+    conf — UnifiedMemoryManager.scala:49's execution-pool analog)."""
+    budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
+    if budget <= 0 or agg.mode != "complete":
+        return None
+    if any(a.func.uses_row_base for a in agg.agg_exprs):
+        return None  # packed-position aggs need whole-input row order
+    found = find_streamable_chain(agg)
+    if found is None:
+        return None
+    chain, leaf = found
+    if not isinstance(leaf, P.ScanExec) or \
+            not hasattr(leaf.source, "load_chunks"):
+        return None
+    from ..io.device_cache import estimated_scan_bytes
+    est_b = estimated_scan_bytes(leaf)
+    if est_b is not None and est_b <= budget:
+        return None
+    return stream_scan_aggregate_spill(agg, chain, leaf, conf, cache)
 
 
 def _dict_growth_guard(agg: "P.HashAggregateExec", prep):
@@ -507,6 +627,11 @@ def _prefer_resident(leaf: "P.ScanExec", conf) -> bool:
     host ingest entirely — the round-3 headline perf fix)."""
     from ..io.device_cache import (CACHE_BYTES_KEY, estimated_scan_bytes,
                                    is_cached, scan_cache_key)
+    mem_budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
+    if mem_budget > 0:
+        est = estimated_scan_bytes(leaf)
+        if est is None or est > mem_budget:
+            return False  # over the device budget: must stream
     budget = int(conf.get(CACHE_BYTES_KEY))
     if budget <= 0:
         return False

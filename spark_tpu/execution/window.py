@@ -184,3 +184,179 @@ def _max_of(dt):
 def _min_of(dt):
     return np.array(np.finfo(dt).min if jnp.issubdtype(dt, jnp.floating)
                     else np.iinfo(dt).min, dt)
+
+
+# ---------------------------------------------------------------------------
+# Specified frames: ROWS/RANGE BETWEEN (reference: WindowExec.scala:36
+# frame processors — SlidingWindowFunctionFrame & friends as vectorized
+# prefix sums + sparse-table range queries instead of per-row loops)
+# ---------------------------------------------------------------------------
+
+from ..window import UNBOUNDED_FOLLOWING, UNBOUNDED_PRECEDING  # noqa: E402
+
+
+def _seg_end_pos(starts, cap):
+    """Position of the LAST row of each row's partition segment."""
+    return _last_peer_pos(starts, cap)
+
+
+def _first_peer_pos(change, cap):
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    return _cummax_where(change, iota, jnp.int32(0))
+
+
+def _searchsorted_seg(keys, seg_lo, seg_hi, targets, side: str, cap: int):
+    """Vectorized per-row binary search WITHIN each row's segment:
+    first position p in [seg_lo, seg_hi+1] with keys[p] >= target
+    (side='left') or > target (side='right'). keys must be ascending
+    within every segment (they are: rows sort by partition then key)."""
+    lo = seg_lo.astype(jnp.int32)
+    hi = (seg_hi + 1).astype(jnp.int32)
+    steps = max(1, int(np.ceil(np.log2(max(cap, 2)))) + 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        kv = jnp.take(keys, jnp.clip(mid, 0, cap - 1))
+        go_right = (kv < targets) if side == "left" else (kv <= targets)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def frame_bounds(frame, starts, change, cap,
+                 ordered: bool, n_valid=None,
+                 range_key=None, range_key_valid=None):
+    """Per-row INCLUSIVE sorted-position bounds [lo, hi] of the frame.
+
+    frame: None | ("rows"|"range", start, end) with UNBOUNDED sentinels.
+    `n_valid` is the live-row count: dead (filtered) rows sort to the
+    global tail, so the LAST segment's end must clamp to n_valid-1 or
+    frames would span garbage rows. For "range", `range_key` is the
+    single ascending numeric order key in sorted order, SANITIZED to be
+    monotone (NULL-key and dead rows carry ±sentinels — see
+    sanitize_range_key); NULL-key rows take their peer group as the
+    frame (nulls sort together)."""
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    seg_lo = _seg_start_pos(starts, cap)
+    seg_hi = _seg_end_pos(starts, cap)
+    if n_valid is not None:
+        seg_hi = jnp.minimum(seg_hi, jnp.maximum(n_valid - 1, 0)
+                             .astype(seg_hi.dtype))
+    if frame is None:
+        if not ordered:
+            return seg_lo, seg_hi
+        return seg_lo, jnp.minimum(_last_peer_pos(change, cap), seg_hi)
+    kind, a, b = frame
+    if kind == "rows":
+        # offsets past the capacity behave as unbounded (they clamp to
+        # the partition anyway), keeping arbitrary user offsets out of
+        # the int32 index arithmetic
+        a = max(a, -cap - 1)
+        b = min(b, cap + 1)
+        lo = seg_lo if a <= UNBOUNDED_PRECEDING else \
+            jnp.maximum(seg_lo, iota + jnp.int32(a))
+        hi = seg_hi if b >= UNBOUNDED_FOLLOWING else \
+            jnp.minimum(seg_hi, iota + jnp.int32(b))
+        return lo, hi
+    # RANGE: value-space offsets on the (ascending, sanitized) order key
+    key = range_key
+    if a <= UNBOUNDED_PRECEDING:
+        lo = seg_lo
+    else:
+        lo = _searchsorted_seg(key, seg_lo, seg_hi, key + a, "left", cap)
+    if b >= UNBOUNDED_FOLLOWING:
+        hi = seg_hi
+    else:
+        hi = _searchsorted_seg(key, seg_lo, seg_hi, key + b,
+                               "right", cap) - 1
+    if range_key_valid is not None:
+        # NULL order keys: the frame is the row's peer group
+        fp = _first_peer_pos(change, cap)
+        lp = jnp.minimum(_last_peer_pos(change, cap), seg_hi)
+        lo = jnp.where(range_key_valid, lo, fp)
+        hi = jnp.where(range_key_valid, hi, lp)
+    return lo, hi
+
+
+def sanitize_range_key(key, key_valid, valid_sorted, nulls_first: bool):
+    """Make the sorted order key monotone over every [seg_lo, seg_hi]
+    search range: NULL-key rows (which sort to the segment's head for
+    NULLS FIRST, tail for NULLS LAST) and dead rows (global tail) carry
+    raw garbage values that would break the binary search. Replace them
+    with the matching ±extreme sentinel; the searched targets (finite
+    key ± offset) never land inside the sentinel regions, and NULL rows'
+    own bounds are overridden to their peer group afterwards."""
+    if jnp.issubdtype(key.dtype, jnp.integer):
+        key = key.astype(jnp.int64)
+        lo_s = jnp.iinfo(jnp.int64).min
+        hi_s = jnp.iinfo(jnp.int64).max
+    else:
+        key = key.astype(jnp.float64)
+        lo_s = -jnp.inf
+        hi_s = jnp.inf
+    dead_or_null = ~valid_sorted if key_valid is None else \
+        (~valid_sorted | ~key_valid)
+    null_sentinel = lo_s if nulls_first else hi_s
+    key = jnp.where(dead_or_null & valid_sorted, null_sentinel, key)
+    key = jnp.where(~valid_sorted, hi_s, key)
+    return key
+
+
+def _prefix_frame(contrib, lo, hi, cap):
+    """sum over inclusive positions [lo, hi] via one prefix scan."""
+    acc = contrib.astype(
+        jnp.float64 if jnp.issubdtype(contrib.dtype, jnp.floating)
+        else jnp.int64)
+    pref = jnp.cumsum(acc)
+    hi_c = jnp.clip(hi, 0, cap - 1)
+    lo_c = jnp.clip(lo, 0, cap - 1)
+    total = (jnp.take(pref, hi_c) - jnp.take(pref, lo_c)
+             + jnp.take(acc, lo_c))
+    return jnp.where(hi < lo, jnp.zeros((), acc.dtype), total)
+
+
+def _rmq_frame(contrib, lo, hi, cap: int, kind: str,
+               max_len: Optional[int] = None):
+    """min/max over inclusive [lo, hi] via a sparse table: O(1) per-row
+    query — the vectorized seat of the reference's sliding frame
+    processors. `max_len` (known for finite ROWS frames) caps the table
+    at log2(max_len)+1 levels, so a small sliding window costs O(n)
+    memory instead of O(n log n) (code-review r5)."""
+    op = jnp.minimum if kind == "min" else jnp.maximum
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    bound = cap if max_len is None else min(cap, max(max_len, 1))
+    levels = [contrib]
+    k = 1
+    while (1 << k) <= bound:
+        half = 1 << (k - 1)
+        prev = levels[-1]
+        levels.append(op(prev, jnp.take(
+            prev, jnp.clip(iota + half, 0, cap - 1))))
+        k += 1
+    stacked = jnp.stack(levels)            # [L, cap]
+    length = jnp.maximum(hi - lo + 1, 1)
+    lv = jnp.floor(jnp.log2(length.astype(jnp.float64))).astype(jnp.int32)
+    lv = jnp.clip(lv, 0, len(levels) - 1)
+    flat = stacked.reshape(-1)
+    lo_c = jnp.clip(lo, 0, cap - 1)
+    right = jnp.clip(hi - (1 << lv.astype(jnp.int64)).astype(jnp.int32) + 1,
+                     0, cap - 1)
+    x1 = jnp.take(flat, lv * cap + lo_c)
+    x2 = jnp.take(flat, lv * cap + right)
+    return op(x1, x2)
+
+
+def framed_agg(kind: str, values, validity, lo, hi, cap: int,
+               max_len: Optional[int] = None):
+    """sum/count/min/max over explicit per-row frame bounds. Returns
+    (value, count-in-frame); empty frames report count 0 (NULL)."""
+    mask = validity if validity is not None else jnp.ones((cap,), jnp.bool_)
+    cnt = _prefix_frame(mask.astype(jnp.int64), lo, hi, cap)
+    if kind == "count":
+        return cnt, cnt
+    if kind in ("sum",):
+        contrib = jnp.where(mask, values, jnp.zeros((), values.dtype))
+        return _prefix_frame(contrib, lo, hi, cap).astype(values.dtype), cnt
+    neutral = _max_of(values.dtype) if kind == "min" else _min_of(values.dtype)
+    contrib = jnp.where(mask, values, neutral)
+    return _rmq_frame(contrib, lo, hi, cap, kind, max_len), cnt

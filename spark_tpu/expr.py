@@ -46,6 +46,9 @@ class Vec:
     validity: Any = None  # None = all valid
     dictionary: Optional[pa.Array] = None
     bits: Optional[int] = None
+    # ARRAY columns: flattened-element layout (columnar.Column contract)
+    offsets: Any = None
+    elem_validity: Any = None
 
     def valid_mask(self):
         if self.validity is None:
@@ -211,7 +214,8 @@ class ColumnRef(Expression):
     def eval(self, batch: Batch) -> Vec:
         col = _resolve_column(batch, self._name)
         return Vec(col.data, col.dtype, col.validity, col.dictionary,
-                   bits=getattr(col, "bits", None))
+                   bits=getattr(col, "bits", None),
+                   offsets=col.offsets, elem_validity=col.elem_validity)
 
     def references(self) -> set:
         return {self._name}

@@ -1,0 +1,258 @@
+"""Array (complex-type) expressions: array(), size, array_contains,
+element_at, explode.
+
+Reference: `sql/catalyst/.../expressions/collectionOperations.scala` +
+`complexTypeCreator.scala`, re-designed for the offsets-encoded device
+layout (columnar.Column: flattened elements + int32 offsets — the Arrow
+List layout instead of `UnsafeArrayData.java:1`). Every operation is a
+whole-column vectorized pass; per-row element slices resolve through
+offsets arithmetic and segment gathers, never per-row loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .expr import (AnalysisError, Expression, Literal, Vec, _and_valid,
+                   _wrap, cast_vec)
+
+
+def _value_segments(offsets, n_values: int):
+    """For each flattened value slot, the row index owning it (cap for
+    the dead tail past the last offset)."""
+    iota = jnp.arange(n_values, dtype=jnp.int32)
+    return jnp.searchsorted(offsets, iota, side="right") - 1
+
+
+class MakeArray(Expression):
+    """array(e1, e2, ...): each row's array is the N evaluated scalars
+    (complexTypeCreator.scala CreateArray)."""
+
+    def __init__(self, *children):
+        if not children:
+            raise AnalysisError("array() needs at least one element")
+        self.children = tuple(_wrap(c) for c in children)
+
+    def dtype(self, schema):
+        dts = [c.dtype(schema) for c in self.children]
+        out = dts[0]
+        for dt in dts[1:]:
+            out = T.common_type(out, dt)
+        return T.ArrayType(out)
+
+    def nullable(self, schema):
+        return False
+
+    def eval(self, batch):
+        out_t = self.dtype(batch.schema())
+        elem_t = out_t.element
+        vs = [cast_vec(c.eval(batch), elem_t) for c in self.children]
+        if any(v.dictionary is not None for v in vs):
+            raise AnalysisError(
+                "array() over string columns is not supported (per-"
+                "column dictionaries have no shared encoding)")
+        cap = batch.capacity
+        n = len(vs)
+        data = jnp.stack([v.data for v in vs], axis=1).reshape(-1)
+        valids = [v.validity if v.validity is not None
+                  else jnp.ones((cap,), jnp.bool_) for v in vs]
+        if all(v.validity is None for v in vs):
+            ev = None
+        else:
+            ev = jnp.stack(valids, axis=1).reshape(-1)
+        offsets = (jnp.arange(cap + 1, dtype=jnp.int32) * n)
+        return Vec(data, out_t, None, None, offsets=offsets,
+                   elem_validity=ev)
+
+    def name(self):
+        return f"array({', '.join(c.name() for c in self.children)})"
+
+    def __repr__(self):
+        return f"array({', '.join(map(repr, self.children))})"
+
+
+class Size(Expression):
+    """size(arr): element count per row; NULL input -> -1 (the
+    reference's legacy sizeOfNull=true default)."""
+
+    def __init__(self, child):
+        self.children = (_wrap(child),)
+
+    def dtype(self, schema):
+        return T.INT
+
+    def nullable(self, schema):
+        return False
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.offsets is None:
+            raise AnalysisError(f"size() needs an array, got {v.dtype!r}")
+        sizes = (v.offsets[1:] - v.offsets[:-1]).astype(jnp.int32)
+        if v.validity is not None:
+            sizes = jnp.where(v.validity, sizes, jnp.int32(-1))
+        return Vec(sizes, T.INT, None)
+
+    def __repr__(self):
+        return f"size({self.children[0]!r})"
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, value): NULL row -> NULL; contains-null
+    semantics follow the reference (no three-valued fallback: a missing
+    match with null elements present yields NULL)."""
+
+    def __init__(self, child, value):
+        self.children = (_wrap(child), _wrap(value))
+
+    def dtype(self, schema):
+        return T.BOOLEAN
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.offsets is None:
+            raise AnalysisError("array_contains() needs an array")
+        lit = self.children[1]
+        if not isinstance(lit, Literal):
+            raise AnalysisError(
+                "array_contains() requires a literal search value")
+        elem_t = v.dtype.element
+        if isinstance(elem_t, T.StringType):
+            if v.dictionary is None:
+                raise AnalysisError("string array without dictionary")
+            import pyarrow.compute as pc
+            idx = pc.index_in(lit.value, value_set=v.dictionary).as_py()
+            needle = jnp.int32(-1 if idx is None else idx)
+        else:
+            needle = jnp.asarray(lit.value, v.data.dtype)
+        nvals = v.data.shape[0]
+        seg = _value_segments(v.offsets, nvals)
+        hit = v.data == needle
+        has_null_elem = jnp.zeros((batch.capacity,), jnp.bool_)
+        if v.elem_validity is not None:
+            hit = hit & v.elem_validity
+            has_null_elem = jnp.zeros((batch.capacity + 1,), jnp.bool_) \
+                .at[jnp.clip(seg, 0, batch.capacity)].max(
+                    ~v.elem_validity)[:batch.capacity]
+        found = jnp.zeros((batch.capacity + 1,), jnp.bool_).at[
+            jnp.clip(seg, 0, batch.capacity)].max(hit)[:batch.capacity]
+        # NULL when not found but a NULL element exists (reference
+        # ArrayContains three-valued logic)
+        validity = ~(~found & has_null_elem)
+        validity = _and_valid(v.validity, validity)
+        return Vec(found, T.BOOLEAN, validity)
+
+    def __repr__(self):
+        return (f"array_contains({self.children[0]!r}, "
+                f"{self.children[1]!r})")
+
+
+class ElementAt(Expression):
+    """element_at(arr, i): 1-based; negative indexes from the end;
+    out-of-bounds -> NULL (non-ANSI reference behavior)."""
+
+    def __init__(self, child, index):
+        self.children = (_wrap(child), _wrap(index))
+
+    def dtype(self, schema):
+        dt = self.children[0].dtype(schema)
+        if not isinstance(dt, T.ArrayType):
+            raise AnalysisError("element_at() needs an array")
+        return dt.element
+
+    def eval(self, batch):
+        v = self.children[0].eval(batch)
+        if v.offsets is None:
+            raise AnalysisError("element_at() needs an array")
+        iv = cast_vec(self.children[1].eval(batch), T.INT)
+        idx = iv.data
+        if np.ndim(idx) == 0:
+            idx = jnp.broadcast_to(idx, (batch.capacity,))
+        starts = v.offsets[:-1]
+        lens = v.offsets[1:] - starts
+        pos = jnp.where(idx > 0, idx - 1, lens + idx)  # 1-based / from-end
+        ok = (pos >= 0) & (pos < lens) & (idx != 0)
+        slot = jnp.clip(starts + pos, 0, max(v.data.shape[0] - 1, 0))
+        data = jnp.take(v.data, slot)
+        validity = ok
+        if v.elem_validity is not None:
+            validity = validity & jnp.take(v.elem_validity, slot)
+        validity = _and_valid(v.validity, validity)
+        validity = _and_valid(iv.validity, validity)
+        return Vec(data, self.dtype(batch.schema()), validity,
+                   v.dictionary)
+
+    def __repr__(self):
+        return f"element_at({self.children[0]!r}, {self.children[1]!r})"
+
+
+class Explode(Expression):
+    """Marker: one output row per array element. Never evaluates as a
+    column expression — the select paths extract it into a Generate
+    plan node (reference: GenerateExec.scala:1 / ExtractGenerator)."""
+
+    def __init__(self, child, outer: bool = False):
+        self.children = (_wrap(child),)
+        self.outer = outer
+
+    def dtype(self, schema):
+        dt = self.children[0].dtype(schema)
+        if not isinstance(dt, T.ArrayType):
+            raise AnalysisError(f"explode() needs an array, got {dt!r}")
+        return dt.element
+
+    def eval(self, batch):
+        raise AnalysisError(
+            "explode() must be planned through a Generate node (use it "
+            "at the top level of a select list)")
+
+    def name(self):
+        return "col"  # the reference's default generator output name
+
+    def __repr__(self):
+        return f"explode({self.children[0]!r})"
+
+
+def contains_explode(e: Expression) -> bool:
+    if isinstance(e, Explode):
+        return True
+    return any(contains_explode(c) for c in e.children)
+
+
+def extract_generators(plan, exprs):
+    """Pull explode() out of a projection into a Generate plan node
+    (the reference's ExtractGenerator analyzer rule): at most one
+    generator per select list, only at top level / under an alias."""
+    from .expr import Alias, ColumnRef
+    from .plan import logical as L
+    if not any(contains_explode(e) for e in exprs):
+        return plan, list(exprs)
+    gens = []
+    out = []
+    taken = set(plan.schema().names)
+    for e in exprs:
+        base, want = (e.child, e.name()) if isinstance(e, Alias) else \
+            (e, None)
+        if isinstance(base, Explode):
+            name = want or "col"
+            if name in taken:
+                raise AnalysisError(
+                    f"generator output name {name!r} collides")
+            gens.append((base, name))
+            out.append(ColumnRef(name))
+            continue
+        if contains_explode(e):
+            raise AnalysisError(
+                "explode() is only supported at the top level of a "
+                "select list (optionally aliased)")
+        out.append(e)
+    if len(gens) != 1:
+        raise AnalysisError(
+            "only one explode() per select list is supported")
+    gen, name = gens[0]
+    plan = L.Generate(plan, gen.children[0], name, outer=gen.outer)
+    return plan, out

@@ -466,3 +466,37 @@ def sum_distinct(e):
 
 def avg_distinct(e):
     return _AvgDistinct(_expr(e))
+
+
+# -- Python UDFs (ArrowEvalPythonExec.scala:1 / worker.py:504 analog) -------
+
+from .udf import pandas_udf, udf  # noqa: E402,F401
+
+
+# -- arrays (collectionOperations.scala / complexTypeCreator.scala) ---------
+
+from . import expr_array as _A  # noqa: E402
+
+
+def array(*cols):
+    return _A.MakeArray(*[_expr(c) for c in cols])
+
+
+def size(c):
+    return _A.Size(_expr(c))
+
+
+def array_contains(c, value):
+    return _A.ArrayContains(_expr(c), value)
+
+
+def element_at(c, index):
+    return _A.ElementAt(_expr(c), _expr(index))
+
+
+def explode(c):
+    return _A.Explode(_expr(c))
+
+
+def explode_outer(c):
+    return _A.Explode(_expr(c), outer=True)

@@ -131,6 +131,10 @@ def _arrow_schema_to_engine(schema: pa.Schema) -> T.Schema:
             dt = T.TIMESTAMP
         elif at == pa.date32():
             dt = T.DATE
+        elif pa.types.is_list(at) or pa.types.is_large_list(at):
+            elem = _arrow_schema_to_engine(
+                pa.schema([pa.field("e", at.value_type)])).fields[0]
+            dt = T.ArrayType(elem.dtype)
         else:
             dt = _ARROW_TO_DTYPE.get(at)
             if dt is None:

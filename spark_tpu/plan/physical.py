@@ -351,7 +351,9 @@ class ProjectExec(UnaryExec):
             validity = v.validity
             if validity is not None and np.ndim(validity) == 0:
                 validity = jnp.broadcast_to(validity, (cap,))
-            cols[e.name()] = Column(data, v.dtype, validity, v.dictionary)
+            cols[e.name()] = Column(data, v.dtype, validity, v.dictionary,
+                                    offsets=v.offsets,
+                                    elem_validity=v.elem_validity)
         return Batch(cols, batch.selection)
 
     def simple_string(self):
@@ -862,9 +864,45 @@ class WindowExec(UnaryExec):
                     val_s = val_s & valid_sorted
                 else:
                     val_s = valid_sorted
-                out, cnt = win.windowed_agg(
-                    "sum" if w.kind == "avg" else w.kind, data_s, val_s,
-                    gid, cap, starts, change, bool(spec._order), cap)
+                frame = w.spec._frame
+                if frame is not None and frame[0] == "range":
+                    from ..window import UNBOUNDED_PRECEDING as _UP
+                    if frame[1] <= _UP and frame[2] == 0:
+                        # RANGE UNBOUNDED PRECEDING .. CURRENT ROW is
+                        # exactly the default peer frame: no value
+                        # arithmetic, so any order keys are fine
+                        frame = None
+                if frame is None:
+                    out, cnt = win.windowed_agg(
+                        "sum" if w.kind == "avg" else w.kind, data_s,
+                        val_s, gid, cap, starts, change,
+                        bool(spec._order), cap)
+                else:
+                    # ROWS/RANGE BETWEEN (WindowExec.scala:36 frames)
+                    if not spec._order:
+                        raise AnalysisError(
+                            "a window frame requires an ORDER BY in "
+                            "its window specification")
+                    range_key = range_key_valid = None
+                    if frame[0] == "range":
+                        range_key, range_key_valid = \
+                            self._range_frame_key(batch, spec, frame,
+                                                  base, perm,
+                                                  valid_sorted)
+                    lo, hi = win.frame_bounds(
+                        frame, starts, change, cap, bool(spec._order),
+                        n_valid=jnp.sum(valid_sorted.astype(jnp.int32)),
+                        range_key=range_key,
+                        range_key_valid=range_key_valid)
+                    max_len = None
+                    if frame[0] == "rows":
+                        from ..window import (UNBOUNDED_FOLLOWING as _UF,
+                                              UNBOUNDED_PRECEDING as _UP2)
+                        if frame[1] > _UP2 and frame[2] < _UF:
+                            max_len = min(cap, frame[2] - frame[1] + 1)
+                    out, cnt = win.framed_agg(
+                        "sum" if w.kind == "avg" else w.kind, data_s,
+                        val_s, lo, hi, cap, max_len=max_len)
                 if w.kind == "avg":
                     safe = jnp.maximum(cnt, 1)
                     if isinstance(out_dtype, T.DecimalType):
@@ -895,11 +933,134 @@ class WindowExec(UnaryExec):
                                     out_dtype, validity, src_dict)
         return Batch(new_cols, batch.selection)
 
+    def _range_frame_key(self, batch, spec, frame, base, perm,
+                         valid_sorted):
+        """Sorted order-key values for a RANGE frame with value-space
+        offsets: exactly one ascending numeric/date order key (the
+        reference's RangeFrame constraint). Keys are sanitized so NULL
+        and filtered rows carry monotone sentinels (see
+        win.sanitize_range_key)."""
+        from ..execution import window as win
+        from ..window import UNBOUNDED_FOLLOWING, UNBOUNDED_PRECEDING
+        _, a, b = frame
+        if a <= UNBOUNDED_PRECEDING and b >= UNBOUNDED_FOLLOWING:
+            return None, None
+        if len(spec._order) != 1:
+            raise AnalysisError(
+                "RANGE BETWEEN with offsets requires exactly one ORDER "
+                "BY key")
+        o = spec._order[0]
+        if not o.ascending:
+            raise AnalysisError(
+                "RANGE BETWEEN with offsets supports ascending order "
+                "keys only")
+        v = o.child.eval(batch)
+        if v.dictionary is not None or isinstance(
+                v.dtype, (T.StringType, T.BooleanType)):
+            raise AnalysisError(
+                "RANGE BETWEEN needs a numeric or date order key")
+        key = jnp.take(v.data, perm)
+        kv = None if v.validity is None else jnp.take(v.validity, perm)
+        key = win.sanitize_range_key(key, kv, valid_sorted,
+                                     o.nulls_first)
+        return key, kv
+
     def simple_string(self):
         # the FULL spec must be in the fingerprint: the compiled-stage
         # cache keys on describe(), and two windows differing only in
         # partition/order would otherwise collide
         return f"WindowExec({[(repr(w), n) for w, n in self.wexprs]})"
+
+
+class GenerateExec(UnaryExec):
+    """explode: one output row per flattened array element. Output
+    capacity is the VALUES capacity — a static shape (the flattened
+    element array's padded length), so unlike the reference's
+    `GenerateExec.scala:1` row iterator no AQE sizing is needed: element
+    slots map back to their rows via one searchsorted over offsets and
+    every child column gathers by that segment id. `outer` appends one
+    slot per input row for empty/NULL arrays (explode_outer)."""
+
+    def __init__(self, child: PhysicalPlan, gen_expr, out_name: str,
+                 out_schema: T.Schema, outer: bool = False):
+        self.children = (child,)
+        self.gen_expr = gen_expr
+        self.out_name = out_name
+        self._schema = out_schema
+        self.outer = outer
+
+    def schema(self):
+        return self._schema
+
+    def compute(self, ctx, inputs):
+        batch = inputs[0]
+        cap = batch.capacity
+        v = self.gen_expr.eval(batch)
+        if v.offsets is None:
+            raise AnalysisError(
+                f"explode() needs an array, got {v.dtype!r}")
+        vcap = max(int(v.data.shape[0]), 1)
+        iota = jnp.arange(vcap, dtype=jnp.int32)
+        seg = jnp.searchsorted(v.offsets, iota, side="right") - 1
+        seg_c = jnp.clip(seg, 0, cap - 1)
+        total = v.offsets[-1]
+        row_live = batch.selection_mask()
+        live = (iota < total) & jnp.take(row_live, seg_c)
+        if v.validity is not None:
+            live = live & jnp.take(v.validity, seg_c)
+
+        def replicate(col: Column, idx):
+            data = jnp.take(col.data, idx)
+            valid = None if col.validity is None else \
+                jnp.take(col.validity, idx)
+            return data, valid
+
+        elem_t = v.dtype.element
+        parts = {}
+        for name, col in batch.columns.items():
+            if col.offsets is not None:
+                continue  # array columns do not replicate (see schema)
+            parts[name] = replicate(col, seg_c)
+        elem_data = v.data
+        elem_valid = v.elem_validity
+        sel = live
+        if self.outer:
+            # one extra slot per input row, live only for empty/NULL
+            # arrays; its element is NULL (explode_outer semantics)
+            lens = v.offsets[1:] - v.offsets[:-1]
+            empty = lens == 0
+            if v.validity is not None:
+                empty = empty | ~v.validity
+            extra_live = row_live & empty
+            for name, col in batch.columns.items():
+                if name not in parts:
+                    continue
+                d, va = parts[name]
+                d2 = jnp.concatenate([d, col.data])
+                va2 = None
+                if va is not None:
+                    va2 = jnp.concatenate([va, col.validity])
+                parts[name] = (d2, va2)
+            elem_data = jnp.concatenate(
+                [elem_data, jnp.zeros((cap,), elem_data.dtype)])
+            ev_main = elem_valid if elem_valid is not None else \
+                jnp.ones((vcap,), jnp.bool_)
+            elem_valid = jnp.concatenate(
+                [ev_main, jnp.zeros((cap,), jnp.bool_)])
+            sel = jnp.concatenate([live, extra_live])
+
+        cols = {n: Column(d, batch.columns[n].dtype, va,
+                          batch.columns[n].dictionary)
+                for n, (d, va) in parts.items()}
+        cols[self.out_name] = Column(elem_data, elem_t, elem_valid,
+                                     v.dictionary)
+        ctx.add_metric(f"gen_rows_{self.out_name}",
+                       jnp.sum(sel.astype(jnp.int64)))
+        return Batch(cols, sel)
+
+    def simple_string(self):
+        return (f"GenerateExec(explode{'_outer' if self.outer else ''}"
+                f"({self.gen_expr!r}) AS {self.out_name})")
 
 
 class LimitExec(UnaryExec):

@@ -150,6 +150,10 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
     if isinstance(plan, L.WindowPlan):
         return P.WindowExec(_convert(plan.child, conf, n), plan.wexprs,
                             plan.schema())
+    if isinstance(plan, L.Generate):
+        return P.GenerateExec(_convert(plan.child, conf, n),
+                              plan.gen_expr, plan.out_name,
+                              plan.schema(), outer=plan.outer)
     if isinstance(plan, L.Sort):
         return P.SortExec(_convert(plan.child, conf, n), plan.orders)
     if isinstance(plan, L.Limit):

@@ -23,7 +23,8 @@ class SparkTpuSession:
 
     def __init__(self, conf: Optional[Conf] = None):
         self.conf = conf or Conf()
-        self.catalog: Dict[str, TableSource] = {}
+        from .catalog import Catalog
+        self.catalog: Catalog = Catalog(self)
         self._stage_cache: Dict[str, object] = {}
         # plan-fingerprint data cache (reference: CacheManager.scala):
         # requested marks fill with materialized Arrow tables on first
@@ -36,6 +37,8 @@ class SparkTpuSession:
         # AQE overflow loop; repeated executions seed these and skip the
         # overflow->re-jit ramp
         self._aqe_caps: Dict[str, Dict[str, int]] = {}
+        from .udf import UDFRegistration
+        self.udf = UDFRegistration(self)
         SparkTpuSession._active = self
 
     # -- data cache ---------------------------------------------------------

@@ -86,10 +86,11 @@ def _contains_agg(e: Expression) -> bool:
 
 
 class Parser:
-    def __init__(self, text: str):
+    def __init__(self, text: str, session=None):
         self.text = text
         self.tokens = tokenize(text)
         self.i = 0
+        self.session = session  # for session-registered UDF lookup
 
     # -- token helpers ------------------------------------------------------
 
@@ -661,6 +662,49 @@ class Parser:
         self.expect_op(")")
         return self._scalar_function(name, args)
 
+    def _parse_frame_clause(self):
+        """ROWS|RANGE BETWEEN <bound> AND <bound> (or the single-bound
+        short form `ROWS n PRECEDING`), reference SqlBase.g4
+        windowFrame."""
+        from ..window import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
+                              UNBOUNDED_PRECEDING)
+        kind = "rows" if self.eat_kw("ROWS") else "range"
+        if kind == "range":
+            self.expect_kw("RANGE")
+
+        def bound(default_end=False):
+            if self.eat_kw("UNBOUNDED"):
+                if self.eat_kw("PRECEDING"):
+                    return UNBOUNDED_PRECEDING
+                self.expect_kw("FOLLOWING")
+                return UNBOUNDED_FOLLOWING
+            if self.eat_kw("CURRENT"):
+                self.expect_kw("ROW")
+                return CURRENT_ROW
+            t = self.next()
+            if t.kind != "number":
+                raise ParseError(
+                    f"expected a frame bound at {t.pos}, got {t.value!r}")
+            try:
+                n = int(t.value)
+            except ValueError:
+                raise ParseError(
+                    f"frame bounds must be integers, got {t.value!r} "
+                    f"at {t.pos}") from None
+            if self.eat_kw("PRECEDING"):
+                return -n
+            self.expect_kw("FOLLOWING")
+            return n
+
+        if self.eat_kw("BETWEEN"):
+            start = bound()
+            self.expect_kw("AND")
+            end = bound()
+        else:
+            start = bound()
+            end = CURRENT_ROW
+        return (kind, start, end)
+
     def _parse_over(self, call: Expression) -> Expression:
         """fn(...) OVER ([PARTITION BY ...] [ORDER BY ...])."""
         from ..window import WindowExpr, WindowSpec
@@ -682,8 +726,11 @@ class Parser:
                 order.append(SortOrder(e, ascending=asc, nulls_first=nf))
                 if not self.eat_op(","):
                     break
+        frame = None
+        if self.at_kw("ROWS", "RANGE"):
+            frame = self._parse_frame_clause()
         self.expect_op(")")
-        spec = WindowSpec(tuple(partition), tuple(order))
+        spec = WindowSpec(tuple(partition), tuple(order), frame)
         if isinstance(call, _RankingCall):
             if not order:
                 raise ParseError(
@@ -713,6 +760,11 @@ class Parser:
         out = lookup(name, args)
         if out is not None:
             return out
+        # session-registered Python UDFs (UDFRegistration.scala analog)
+        if self.session is not None:
+            u = self.session.udf.lookup(name)
+            if u is not None:
+                return u(*args)
         raise ParseError(f"unknown function {name!r}")
 
 
@@ -1267,11 +1319,13 @@ class Lowerer:
             (sel.having is not None and _contains_agg(sel.having))
 
         from ..window import contains_window
+        from ..expr_array import contains_explode
         has_window = any(contains_window(e) for e, _ in items)
-        if has_window:
+        has_gen = any(contains_explode(e) for e, _ in items)
+        if has_window or has_gen:
             if has_agg:
                 raise AnalysisError(
-                    "window functions with GROUP BY/aggregates in one "
+                    "window functions / explode with GROUP BY in one "
                     "SELECT are not supported yet (use a FROM subquery)")
             plan, items = self._extract_window_items(plan, items)
 
@@ -1637,8 +1691,11 @@ class Lowerer:
         projection (shared with the DataFrame layer: one node — one
         sort — per distinct spec; collision-safe names)."""
         from ..window import extract_window_exprs
+        from ..expr_array import contains_explode, extract_generators
         exprs = [Alias(e, a) if a else e for e, a in items]
         plan, out = extract_window_exprs(plan, exprs)
+        if any(contains_explode(e) for e in out):
+            plan, out = extract_generators(plan, out)
         rebuilt = []
         for (orig_e, a), new_e in zip(items, out):
             if a and isinstance(new_e, Alias):
@@ -1671,7 +1728,18 @@ class Lowerer:
 
 
 def parse_sql(query: str, session) -> L.LogicalPlan:
-    """Parse one SELECT statement into a logical plan bound to the
-    session catalog (the `SparkSession.sql:613` entry point)."""
-    sel = Parser(query).parse_statement()
+    """Parse one statement into a logical plan bound to the session
+    catalog (the `SparkSession.sql:613` entry point). DDL/DML commands
+    (CREATE/DROP/INSERT/SHOW/DESCRIBE) run eagerly at parse time — the
+    reference's RunnableCommand contract — and lower to a scan over
+    their small result relation."""
+    p = Parser(query, session)
+    t = p.peek()
+    if t.kind == "ident" and t.upper in ("CREATE", "DROP", "INSERT",
+                                         "SHOW", "DESCRIBE", "DESC"):
+        from .ddl import execute_command
+        from ..io.sources import ArrowTableSource
+        table = execute_command(p, session)
+        return L.Scan(ArrowTableSource("__command__", table))
+    sel = p.parse_statement()
     return Lowerer(session).lower(sel)

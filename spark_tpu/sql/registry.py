@@ -206,3 +206,16 @@ def _concat(args: List[Expression]) -> Expression:
 
 
 register("CONCAT", _concat, 1, 64)
+
+
+# -- arrays (collectionOperations.scala) ------------------------------------
+
+from .. import expr_array as _arr  # noqa: E402
+
+register("ARRAY", lambda a: _arr.MakeArray(*a), 1, 64)
+register("SIZE", lambda a: _arr.Size(a[0]), 1, 1)
+register("CARDINALITY", lambda a: _arr.Size(a[0]), 1, 1)
+register("ARRAY_CONTAINS", lambda a: _arr.ArrayContains(a[0], a[1]), 2, 2)
+register("ELEMENT_AT", lambda a: _arr.ElementAt(a[0], a[1]), 2, 2)
+register("EXPLODE", lambda a: _arr.Explode(a[0]), 1, 1)
+register("EXPLODE_OUTER", lambda a: _arr.Explode(a[0], outer=True), 1, 1)

@@ -133,6 +133,31 @@ class NullType(DataType):
     np_dtype = np.dtype(np.int8)
 
 
+@dataclass(frozen=True)
+class ArrayType(DataType):
+    """ARRAY<element>: offsets-encoded on device — the column's data is
+    the FLATTENED element array (element dtype) and an int32 offsets
+    array [rows+1] marks each row's slice, the Arrow List layout rather
+    than the reference's UnsafeArrayData
+    (`sql/catalyst/src/main/java/.../UnsafeArrayData.java:1`)."""
+
+    element: DataType = None  # type: ignore
+    contains_null: bool = True
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        return self.element.np_dtype
+
+    def simple_string(self) -> str:
+        return f"array<{self.element!r}>"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ArrayType) and other.element == self.element
+
+    def __hash__(self) -> int:
+        return hash(("array", self.element))
+
+
 # Singletons, mirroring the reference's `DataTypes` statics.
 BOOLEAN = BooleanType()
 BYTE = ByteType()

@@ -1,0 +1,193 @@
+"""Python UDFs: scalar (row-at-a-time), pandas (vectorized), and
+grouped-map user functions.
+
+The reference runs Python UDFs in forked CPython workers fed Arrow
+batches over sockets (`ArrowEvalPythonExec.scala:1`,
+`core/.../api/python/PythonRunner.scala:84`, `python/pyspark/worker.py:504`).
+This engine IS Python, so the whole IPC stack collapses to a host
+round-trip: the executor materializes the UDF's input subtree (a stage,
+like a QueryStageExec), pulls the referenced columns to host in one
+batched transfer, evaluates the function, and splices the result back as
+a device column. Everything around the UDF stays jitted; the UDF itself
+is the host island — exactly the stage cut the reference makes, minus
+the sockets.
+
+NULL semantics follow the reference's BatchEvalPythonExec: scalar UDFs
+receive Python ``None`` for NULL inputs and may return ``None`` for a
+NULL result; pandas UDFs receive ``pd.Series`` with NaN/None holes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from . import types as T
+from .expr import AnalysisError, Expression, _wrap
+
+
+def _parse_return_type(rt) -> T.DataType:
+    if isinstance(rt, T.DataType):
+        return rt
+    names = {
+        "long": T.LONG, "bigint": T.LONG, "int": T.INT, "integer": T.INT,
+        "double": T.DOUBLE, "float": T.FLOAT, "string": T.STRING,
+        "boolean": T.BOOLEAN, "bool": T.BOOLEAN, "date": T.DATE,
+    }
+    key = str(rt).strip().lower()
+    if key in names:
+        return names[key]
+    raise AnalysisError(f"unsupported UDF return type {rt!r}")
+
+
+class PythonUDF(Expression):
+    """A user function call site. Never evaluates inside a trace — the
+    executor's ExtractPythonUDFs pass (execution/python_eval.py) cuts
+    the plan at this expression and evaluates it on host (the
+    `ExtractPythonUDFs.scala` seam)."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 args: Sequence, name: Optional[str] = None,
+                 vectorized: bool = False):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = tuple(_wrap(a) for a in args)
+        self.udf_name = name or getattr(fn, "__name__", "udf")
+        self.vectorized = vectorized
+
+    def dtype(self, schema):
+        return self.return_type
+
+    def nullable(self, schema):
+        return True
+
+    def eval(self, batch):
+        raise AnalysisError(
+            f"python UDF {self.udf_name!r} reached expression evaluation; "
+            "UDFs are evaluated host-side by the executor's "
+            "ExtractPythonUDFs pass")
+
+    def name(self):
+        return f"{self.udf_name}({', '.join(c.name() for c in self.children)})"
+
+    def __repr__(self):
+        return f"{self.udf_name}({', '.join(map(repr, self.children))})"
+
+
+class UserDefinedFunction:
+    """The object `F.udf(...)` returns: call it with columns to build a
+    PythonUDF expression (pyspark's UserDefinedFunction surface)."""
+
+    def __init__(self, fn: Callable, return_type, name=None,
+                 vectorized=False):
+        self.fn = fn
+        self.return_type = _parse_return_type(return_type)
+        self._name = name or getattr(fn, "__name__", "udf")
+        self.vectorized = vectorized
+
+    def __call__(self, *cols):
+        return PythonUDF(self.fn, self.return_type, cols,
+                         name=self._name, vectorized=self.vectorized)
+
+
+def udf(f=None, returnType=T.DOUBLE):
+    """``udf(lambda x: ..., "long")`` or ``@udf(returnType="long")``."""
+    if f is None or isinstance(f, (str, T.DataType)):
+        rt = returnType if f is None else f
+        return lambda fn: UserDefinedFunction(fn, rt)
+    return UserDefinedFunction(f, returnType)
+
+
+def pandas_udf(f=None, returnType=T.DOUBLE):
+    """Vectorized UDF: the function receives/returns ``pd.Series``
+    (the reference's SQL_SCALAR_PANDAS_UDF over Arrow batches)."""
+    if f is None or isinstance(f, (str, T.DataType)):
+        rt = returnType if f is None else f
+        return lambda fn: UserDefinedFunction(fn, rt, vectorized=True)
+    return UserDefinedFunction(f, returnType, vectorized=True)
+
+
+class UDFRegistration:
+    """`session.udf.register(name, fn, returnType)` — makes the function
+    callable from SQL (the reference's UDFRegistration.scala)."""
+
+    def __init__(self, session):
+        self._session = session
+        self._fns = {}
+
+    def register(self, name: str, fn, returnType=T.DOUBLE):
+        if isinstance(fn, UserDefinedFunction):
+            u = UserDefinedFunction(fn.fn, fn.return_type, name=name,
+                                    vectorized=fn.vectorized)
+        else:
+            u = UserDefinedFunction(fn, returnType, name=name)
+        self._fns[name.lower()] = u
+        return u
+
+    def lookup(self, name: str) -> Optional[UserDefinedFunction]:
+        return self._fns.get(name.lower())
+
+
+# ---------------------------------------------------------------------------
+# Host evaluation (the worker.py:504 loop, minus the socket)
+# ---------------------------------------------------------------------------
+
+def evaluate_udf(node: PythonUDF, arg_arrays, arg_valids, n_rows: int):
+    """Evaluate over host numpy/arrow arg columns ->
+    (values list | np array, validity np array)."""
+    if node.vectorized:
+        series = []
+        for a, v in zip(arg_arrays, arg_valids):
+            s = pd.Series(a)
+            if v is not None:
+                s = s.where(pd.Series(v))
+            series.append(s)
+        out = node.fn(*series)
+        if not isinstance(out, pd.Series):
+            out = pd.Series(out)
+        if len(out) != n_rows:
+            raise RuntimeError(
+                f"pandas UDF {node.udf_name!r} returned {len(out)} rows "
+                f"for {n_rows} input rows")
+        valid = ~out.isna().to_numpy()
+        return out, valid
+    results = []
+    valid = np.ones(n_rows, dtype=bool)
+    for i in range(n_rows):
+        args = []
+        for a, v in zip(arg_arrays, arg_valids):
+            if v is not None and not v[i]:
+                args.append(None)
+            else:
+                x = a[i]
+                args.append(x.item() if isinstance(x, np.generic) else x)
+        r = node.fn(*args)
+        if r is None:
+            valid[i] = False
+            results.append(None)
+        else:
+            results.append(r)
+    return results, valid
+
+
+def result_to_arrow(node: PythonUDF, values, valid) -> pa.Array:
+    """UDF python results -> typed arrow array (NULLs where invalid)."""
+    rt = node.return_type
+    if isinstance(values, pd.Series):
+        values = values.to_numpy(dtype=object, na_value=None)
+    cleaned = [None if not v else x for x, v in zip(values, valid)]
+    if isinstance(rt, T.StringType):
+        return pa.array([None if c is None else str(c) for c in cleaned],
+                        type=pa.string())
+    if isinstance(rt, T.DateType):
+        return pa.array(cleaned, type=pa.date32())
+    arrow_t = {
+        np.dtype(np.int64): pa.int64(), np.dtype(np.int32): pa.int32(),
+        np.dtype(np.float64): pa.float64(),
+        np.dtype(np.float32): pa.float32(),
+        np.dtype(np.bool_): pa.bool_(),
+    }[np.dtype(rt.np_dtype)]
+    return pa.array(cleaned, type=arrow_t)

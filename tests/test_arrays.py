@@ -1,0 +1,129 @@
+"""Arrays + explode: offsets-encoded list columns (reference:
+UnsafeArrayData.java:1 layout -> Arrow List layout on device;
+GenerateExec.scala:1 -> static-capacity GenerateExec)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+
+
+@pytest.fixture
+def adf(session):
+    pdf = pd.DataFrame({
+        "k": np.array([1, 2, 3, 4], np.int64),
+        "a": [[1, 2, 3], [], [4, 5], None],
+        "v": np.array([10.0, 20.0, 30.0, 40.0])})
+    session.register_table("arr_t", pdf)
+    return session.table("arr_t"), pdf
+
+
+def test_list_roundtrip_ingest_egress(adf):
+    df, pdf = adf
+    out = df.to_pandas()
+    assert [list(x) if x is not None else None
+            for x in out["a"].tolist()] == [[1, 2, 3], [], [4, 5], None]
+
+
+def test_size_and_contains_and_element_at(adf):
+    df, _ = adf
+    out = df.select(
+        col("k"),
+        F.size(col("a")).alias("n"),
+        F.array_contains(col("a"), 4).alias("c"),
+        F.element_at(col("a"), 2).alias("e2"),
+        F.element_at(col("a"), -1).alias("last"),
+    ).to_pandas().sort_values("k").reset_index(drop=True)
+    assert out["n"].tolist() == [3, 0, 2, -1]  # NULL -> -1 (legacy)
+    assert out["c"].tolist()[:3] == [False, False, True]
+    assert out["e2"][0] == 2 and pd.isna(out["e2"][1]) \
+        and out["e2"][2] == 5 and pd.isna(out["e2"][3])
+    assert out["last"][0] == 3 and out["last"][2] == 5
+
+
+def test_make_array_and_explode_roundtrip(session):
+    pdf = pd.DataFrame({"x": np.array([1, 2], np.int64),
+                        "y": np.array([10, 20], np.int64)})
+    session.register_table("mk_t", pdf)
+    out = (session.table("mk_t")
+           .select(col("x"), F.array(col("x"), col("y")).alias("a"))
+           .select(col("x"), F.explode(col("a")).alias("e"))
+           .to_pandas().sort_values(["x", "e"]).reset_index(drop=True))
+    assert out["x"].tolist() == [1, 1, 2, 2]
+    assert out["e"].tolist() == [1, 10, 2, 20]
+
+
+def test_explode_replicates_and_drops_empty(adf):
+    df, _ = adf
+    out = (df.select(col("k"), col("v"),
+                     F.explode(col("a")).alias("e"))
+           .to_pandas().sort_values(["k", "e"]).reset_index(drop=True))
+    # rows 2 (empty) and 4 (NULL) vanish; 1 and 3 replicate
+    assert out["k"].tolist() == [1, 1, 1, 3, 3]
+    assert out["e"].tolist() == [1, 2, 3, 4, 5]
+    assert out["v"].tolist() == [10.0, 10.0, 10.0, 30.0, 30.0]
+
+
+def test_explode_outer_keeps_empty_rows(adf):
+    df, _ = adf
+    out = (df.select(col("k"), F.explode_outer(col("a")).alias("e"))
+           .to_pandas().sort_values(["k", "e"]).reset_index(drop=True))
+    assert out["k"].tolist() == [1, 1, 1, 2, 3, 3, 4]
+    got = out["e"].tolist()
+    assert got[:3] == [1, 2, 3] and got[4:6] == [4, 5]
+    assert pd.isna(got[3]) and pd.isna(got[6])
+
+
+def test_explode_after_filter(adf):
+    df, _ = adf
+    out = (df.filter(col("k") != 1)
+           .select(col("k"), F.explode(col("a")).alias("e"))
+           .to_pandas().sort_values(["k", "e"]).reset_index(drop=True))
+    assert out["k"].tolist() == [3, 3]
+    assert out["e"].tolist() == [4, 5]
+
+
+def test_explode_then_aggregate(adf):
+    df, _ = adf
+    out = (df.select(F.explode(col("a")).alias("e"))
+           .agg(F.sum(col("e")).alias("s"), F.count().alias("c"))
+           .to_pandas())
+    assert int(out["s"][0]) == 15 and int(out["c"][0]) == 5
+
+
+def test_sql_explode_and_array_fns(session, adf):
+    out = session.sql(
+        "SELECT k, explode(a) AS e FROM arr_t WHERE k <> 4 "
+        "ORDER BY k, e").to_pandas()
+    assert out["k"].tolist() == [1, 1, 1, 3, 3]
+    assert out["e"].tolist() == [1, 2, 3, 4, 5]
+    out2 = session.sql(
+        "SELECT k, size(a) AS n, array_contains(a, 1) AS c FROM arr_t "
+        "ORDER BY k").to_pandas()
+    assert out2["n"].tolist() == [3, 0, 2, -1]
+    assert bool(out2["c"][0]) is True and bool(out2["c"][1]) is False
+
+
+def test_string_array_explode(session):
+    pdf = pd.DataFrame({"k": np.array([1, 2], np.int64),
+                        "s": [["aa", "bb"], ["cc"]]})
+    session.register_table("sarr_t", pdf)
+    out = (session.table("sarr_t")
+           .select(col("k"), F.explode(col("s")).alias("w"))
+           .to_pandas().sort_values(["k", "w"]).reset_index(drop=True))
+    assert out["w"].tolist() == ["aa", "bb", "cc"]
+
+
+def test_explode_on_mesh(session, adf):
+    df, _ = adf
+    build = lambda: (df.select(col("k"), F.explode(col("a")).alias("e"))
+                     .agg(F.sum(col("e")).alias("s")).to_pandas())
+    want = build()
+    try:
+        session.conf.set("spark_tpu.sql.mesh.size", 8)
+        got = build()
+    finally:
+        session.conf.set("spark_tpu.sql.mesh.size", 0)
+    assert int(got["s"][0]) == int(want["s"][0]) == 15

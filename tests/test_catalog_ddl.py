@@ -1,0 +1,118 @@
+"""Catalog + DDL/DML: CREATE TABLE [AS SELECT], INSERT INTO, DROP,
+SHOW TABLES, DESCRIBE — and the round-trip across fresh sessions over
+the same warehouse dir (reference: SessionCatalog.scala:1,
+command/tables.scala:1)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu.expr import AnalysisError
+from spark_tpu.functions import col
+
+WH_KEY = "spark_tpu.sql.warehouse.dir"
+
+
+@pytest.fixture
+def wh_session(session, tmp_path):
+    old = session.conf.get(WH_KEY)
+    session.conf.set(WH_KEY, str(tmp_path / "wh"))
+    yield session
+    session.conf.set(WH_KEY, old)
+
+
+def test_create_insert_select_roundtrip(wh_session):
+    s = wh_session
+    s.sql("CREATE TABLE ddl_t (k BIGINT, name STRING, price DECIMAL(10,2))")
+    s.sql("INSERT INTO ddl_t VALUES (1, 'widget', 9.50), (2, 'gadget', 3.25)")
+    s.sql("INSERT INTO ddl_t VALUES (3, NULL, -1.00)")
+    out = s.sql("SELECT k, name, price FROM ddl_t ORDER BY k").to_pandas()
+    assert out["k"].tolist() == [1, 2, 3]
+    assert out["name"].tolist()[:2] == ["widget", "gadget"]
+    assert pd.isna(out["name"][2])
+    assert [float(x) for x in out["price"]] == [9.5, 3.25, -1.0]
+
+
+def test_ctas_and_insert_select(wh_session):
+    s = wh_session
+    pdf = pd.DataFrame({"a": np.arange(10, dtype=np.int64),
+                        "b": np.arange(10, dtype=np.float64) * 1.5})
+    s.register_table("src_view", pdf)
+    s.sql("CREATE TABLE ctas_t AS SELECT a, b * 2 AS b2 FROM src_view "
+          "WHERE a >= 5")
+    out = s.sql("SELECT * FROM ctas_t ORDER BY a").to_pandas()
+    assert out["a"].tolist() == [5, 6, 7, 8, 9]
+    assert np.allclose(out["b2"], [15.0, 18.0, 21.0, 24.0, 27.0])
+    s.sql("INSERT INTO ctas_t SELECT a, b FROM src_view WHERE a < 2")
+    n = s.sql("SELECT count(*) AS c FROM ctas_t").to_pandas()
+    assert int(n["c"][0]) == 7
+
+
+def test_show_describe_drop(wh_session):
+    s = wh_session
+    s.sql("CREATE TABLE show_t (x INT, y STRING)")
+    s.register_table("tmp_v", pd.DataFrame({"z": [1]}))
+    rows = s.sql("SHOW TABLES").to_pandas()
+    by_name = dict(zip(rows["tableName"], rows["isTemporary"]))
+    assert by_name["show_t"] == False  # noqa: E712
+    assert by_name["tmp_v"] == True  # noqa: E712
+    d = s.sql("DESCRIBE show_t").to_pandas()
+    assert d["col_name"].tolist() == ["x", "y"]
+    s.sql("DROP TABLE show_t")
+    rows = s.sql("SHOW TABLES").to_pandas()
+    assert "show_t" not in rows["tableName"].tolist()
+    with pytest.raises(AnalysisError):
+        s.sql("DROP TABLE show_t")
+    s.sql("DROP TABLE IF EXISTS show_t")  # no raise
+
+
+def test_create_errors_and_replace(wh_session):
+    s = wh_session
+    s.sql("CREATE TABLE err_t (x INT)")
+    with pytest.raises(AnalysisError):
+        s.sql("CREATE TABLE err_t (x INT)")
+    s.sql("CREATE TABLE IF NOT EXISTS err_t (x INT)")  # no raise
+    s.register_table("seed", pd.DataFrame({"x": np.array([7], np.int32)}))
+    s.sql("CREATE OR REPLACE TABLE err_t AS SELECT x FROM seed")
+    out = s.sql("SELECT * FROM err_t").to_pandas()
+    assert out["x"].tolist() == [7]
+
+
+def test_warehouse_survives_fresh_session(tmp_path):
+    """The DDL round-trip bar: a brand-new session over the same
+    warehouse dir sees tables a previous session created."""
+    from spark_tpu.session import SparkTpuSession
+    wh = str(tmp_path / "wh2")
+    s1 = SparkTpuSession()
+    s1.conf.set(WH_KEY, wh)
+    s1.sql("CREATE TABLE persist_t (k BIGINT, v DOUBLE)")
+    s1.sql("INSERT INTO persist_t VALUES (1, 1.5), (2, 2.5)")
+
+    s2 = SparkTpuSession()
+    s2.conf.set(WH_KEY, wh)
+    out = s2.sql("SELECT k, v FROM persist_t ORDER BY k").to_pandas()
+    assert out["k"].tolist() == [1, 2]
+    assert out["v"].tolist() == [1.5, 2.5]
+    assert "persist_t" in [r["name"] for r in s2.catalog.list_tables()]
+    # restore the default active session for later tests
+    SparkTpuSession._active = None
+
+
+def test_insert_position_cast_and_arity_check(wh_session):
+    s = wh_session
+    s.sql("CREATE TABLE cast_t (k BIGINT, v DOUBLE)")
+    s.sql("INSERT INTO cast_t VALUES (1, 2)")  # int -> double cast
+    out = s.sql("SELECT * FROM cast_t").to_pandas()
+    assert out["v"].tolist() == [2.0]
+    with pytest.raises(AnalysisError):
+        s.sql("INSERT INTO cast_t VALUES (1, 2, 3)")
+
+
+def test_dataframe_api_over_persistent_table(wh_session):
+    s = wh_session
+    s.sql("CREATE TABLE api_t (k BIGINT, v DOUBLE)")
+    s.sql("INSERT INTO api_t VALUES (1, 10.0), (2, 20.0), (3, 30.0)")
+    out = (s.table("api_t").filter(col("k") > 1)
+           .agg_sum("v") if hasattr(s.table("api_t"), "agg_sum") else
+           s.table("api_t").filter(col("k") > 1).to_pandas())
+    assert sorted(out["v"].tolist()) == [20.0, 30.0]

@@ -1,0 +1,211 @@
+"""Out-of-HBM execution: the deviceBudget-gated spill paths.
+
+Covers (VERDICT r4 #1, reference `UnsafeExternalSorter.java:1`,
+`ExternalAppendOnlyMap.scala:55`):
+- general-key aggregate spill (partial-mode chunks -> host Arrow ->
+  FINAL re-reduce), incl. through probe-side joins (the TPC-H Q3 shape);
+- external collect: plain chain, LIMIT, ORDER BY+LIMIT (chunked
+  tournament top-n), and pure ORDER BY with host merge;
+- TPC-H Q3/Q5 parity under a budget small enough to force streaming.
+
+Every test pins a tiny deviceBudget + chunk size so the out-of-core
+machinery runs on CI-size data, then checks parity against the same
+query executed whole-input (budget 0).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+
+BUDGET_KEY = "spark_tpu.sql.memory.deviceBudget"
+CHUNK_KEY = "spark_tpu.sql.execution.streamingChunkRows"
+CACHE_KEY = "spark_tpu.sql.io.deviceCacheBytes"
+
+
+@pytest.fixture
+def tiny_budget(session):
+    old = {k: session.conf.get(k) for k in (BUDGET_KEY, CHUNK_KEY,
+                                            CACHE_KEY)}
+    yield session
+    for k, v in old.items():
+        session.conf.set(k, v)
+
+
+def _force_spill(session, chunk_rows=1000):
+    session.conf.set(BUDGET_KEY, 1)  # 1 byte: everything is out-of-core
+    session.conf.set(CHUNK_KEY, chunk_rows)
+    session.conf.set(CACHE_KEY, 0)
+
+
+def _unforce(session):
+    session.conf.set(BUDGET_KEY, 0)
+
+
+def _mk(session, n=5237, name="spill_t", seed=7):
+    rs = np.random.RandomState(seed)
+    pdf = pd.DataFrame({
+        "k": rs.randint(0, 10_000_000, n).astype(np.int64),
+        "g": rs.randint(0, 7, n).astype(np.int64),
+        "v": rs.randn(n),
+        "s": rs.choice(["aa", "bb", "cc", "dd"], n)})
+    session.register_table(name, pdf)
+    return pdf
+
+
+def test_aggregate_spill_unbounded_keys(tiny_budget):
+    """Group keys with no static domain (the Q3 l_orderkey shape) take
+    the partial-spill path and must match the whole-input result."""
+    session = tiny_budget
+    _mk(session, name="spill_agg")
+    q = lambda: (session.table("spill_agg").group_by(col("k"))
+                 .agg(F.sum(col("v")).alias("sv"),
+                      F.count().alias("c"))
+                 .to_pandas().sort_values("k").reset_index(drop=True))
+    _unforce(session)
+    want = q()
+    _force_spill(session)
+    qe_probe = (session.table("spill_agg").group_by(col("k"))
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.count().alias("c"))._qe())
+    got_tbl = qe_probe.collect().to_pandas()
+    assert qe_probe.spilled_partial_rows is not None, \
+        "expected the partial-spill path to engage"
+    got = got_tbl.sort_values("k").reset_index(drop=True)
+    assert got["k"].tolist() == want["k"].tolist()
+    assert got["c"].tolist() == want["c"].tolist()
+    assert np.allclose(got["sv"], want["sv"])
+
+
+def test_aggregate_spill_string_keys(tiny_budget):
+    """Derived/dictionary group keys round-trip through host Arrow (no
+    shared-encoding requirement on the spill path)."""
+    session = tiny_budget
+    _mk(session, name="spill_agg_s")
+    q = lambda: (session.table("spill_agg_s")
+                 .group_by(col("s"), (col("k") % 1000).alias("kb"))
+                 .agg(F.sum(col("v")).alias("sv"))
+                 .to_pandas().sort_values(["s", "kb"])
+                 .reset_index(drop=True))
+    _unforce(session)
+    want = q()
+    _force_spill(session)
+    got = q()
+    assert got["s"].tolist() == want["s"].tolist()
+    assert got["kb"].tolist() == want["kb"].tolist()
+    assert np.allclose(got["sv"], want["sv"])
+
+
+def test_aggregate_spill_through_join(tiny_budget):
+    """The Q3 shape: probe-side join chain under the aggregate; build
+    side resident, probe streamed, partials spilled."""
+    session = tiny_budget
+    pdf = _mk(session, name="spill_fact")
+    dim = pd.DataFrame({"g": np.arange(7, dtype=np.int64),
+                        "w": np.arange(7, dtype=np.float64) * 2.0})
+    session.register_table("spill_dim", dim)
+    q = lambda: (session.table("spill_fact")
+                 .join(session.table("spill_dim"),
+                       left_on=col("g"), right_on=col("g"))
+                 .group_by(col("k"))
+                 .agg(F.sum(col("v") * col("w")).alias("sv"))
+                 .to_pandas().sort_values("k").reset_index(drop=True))
+    _unforce(session)
+    want = q()
+    _force_spill(session)
+    got = q()
+    assert got["k"].tolist() == want["k"].tolist()
+    assert np.allclose(got["sv"], want["sv"])
+
+
+def test_external_collect_plain_chain(tiny_budget):
+    session = tiny_budget
+    _mk(session, name="ext_plain")
+    q = lambda: (session.table("ext_plain")
+                 .filter(col("v") > 0.5)
+                 .select(col("k"), (col("v") * 2).alias("v2"))
+                 .to_pandas().sort_values("k").reset_index(drop=True))
+    _unforce(session)
+    want = q()
+    _force_spill(session)
+    got = q()
+    assert got["k"].tolist() == want["k"].tolist()
+    assert np.allclose(got["v2"], want["v2"])
+
+
+def test_external_collect_order_by_limit(tiny_budget):
+    """Chunked tournament top-n: per-chunk device sort+limit, one final
+    small device sort over the spilled winners."""
+    session = tiny_budget
+    _mk(session, name="ext_topn")
+    q = lambda: (session.table("ext_topn")
+                 .sort(col("v").desc(), col("k"))
+                 .limit(17).to_pandas().reset_index(drop=True))
+    _unforce(session)
+    want = q()
+    _force_spill(session)
+    got = q()
+    assert got["k"].tolist() == want["k"].tolist()
+    assert np.allclose(got["v"], want["v"])
+
+
+def test_external_collect_order_by_host_merge(tiny_budget):
+    """Pure ORDER BY: spilled runs merge on host honoring direction."""
+    session = tiny_budget
+    _mk(session, name="ext_sort")
+    q = lambda: (session.table("ext_sort")
+                 .sort(col("v").desc())
+                 .to_pandas().reset_index(drop=True))
+    _unforce(session)
+    want = q()
+    _force_spill(session)
+    got = q()
+    assert np.allclose(got["v"], want["v"])
+    assert got["k"].head(50).tolist() == want["k"].head(50).tolist()
+
+
+def test_external_collect_plain_limit(tiny_budget):
+    """Plain LIMIT stops streaming once enough rows spilled; rows must
+    come from the input (order unspecified, like the reference)."""
+    session = tiny_budget
+    pdf = _mk(session, name="ext_lim")
+    _force_spill(session)
+    got = session.table("ext_lim").limit(123).to_pandas()
+    assert len(got) == 123
+    assert set(got["k"]).issubset(set(pdf["k"]))
+
+
+def test_tpch_q3_q5_parity_under_budget(session, tmp_path):
+    """TPC-H Q3 (unbounded l_orderkey keys -> partial spill) and Q5
+    (dictionary keys -> direct stream) with the scans forced
+    out-of-core; parity vs the independent pandas goldens."""
+    from spark_tpu.tpch import golden as G
+    from spark_tpu.tpch import queries as Q
+    from spark_tpu.tpch.datagen import write_parquet
+
+    path = str(tmp_path / "tpch_budget")
+    write_parquet(path, 0.01)
+    Q.register_tables(session, path)
+    old = {k: session.conf.get(k) for k in (BUDGET_KEY, CHUNK_KEY,
+                                            CACHE_KEY)}
+    try:
+        session.conf.set(BUDGET_KEY, 1 << 16)
+        session.conf.set(CHUNK_KEY, 10_000)
+        session.conf.set(CACHE_KEY, 0)
+        for qname in ("q3", "q5"):
+            got = Q.QUERIES[qname](session).to_pandas()
+            for c in got.columns:
+                if len(got) and got[c].dtype == object and \
+                        got[c].iloc[0].__class__.__name__ == "Decimal":
+                    got[c] = got[c].astype(float)
+            want = G.GOLDEN[qname](path)
+            if qname == "q5":
+                got = got.sort_values("n_name").reset_index(drop=True)
+                want = want.sort_values("n_name").reset_index(drop=True)
+            G.compare(got.reset_index(drop=True), want,
+                      float_rtol=1e-6, float_atol=1e-4)
+    finally:
+        for k, v in old.items():
+            session.conf.set(k, v)

@@ -153,3 +153,187 @@ def test_sql_rank_requires_over(wdata):
     from spark_tpu.sql.lexer import ParseError
     with pytest.raises(ParseError, match="OVER"):
         session.sql("SELECT rank() FROM wdata")
+
+
+# -- ROWS/RANGE BETWEEN frames (reference: WindowExec.scala:36) -------------
+
+def _frame_pdf():
+    rs = np.random.RandomState(11)
+    n = 500
+    return pd.DataFrame({
+        "g": rs.randint(0, 7, n).astype(np.int64),
+        "t": rs.permutation(n).astype(np.int64),
+        "v": rs.randn(n)})
+
+
+def test_rows_between_sliding_parity_with_pandas(session):
+    """sum/avg/min/max/count over ROWS BETWEEN 2 PRECEDING AND CURRENT
+    ROW vs pandas rolling(3, min_periods=1) per partition."""
+    from spark_tpu.window import Window
+    pdf = _frame_pdf()
+    session.register_table("wf_rows", pdf)
+    w = (Window.partition_by(col("g")).order_by(col("t"))
+         .rows_between(-2, 0))
+    out = (session.table("wf_rows").select(
+        col("g"), col("t"),
+        F.sum(col("v")).over(w).alias("s"),
+        F.avg(col("v")).over(w).alias("a"),
+        F.min(col("v")).over(w).alias("mn"),
+        F.max(col("v")).over(w).alias("mx"),
+        F.count(col("v")).over(w).alias("c"),
+    ).to_pandas().sort_values(["g", "t"]).reset_index(drop=True))
+    want = pdf.sort_values(["g", "t"]).reset_index(drop=True)
+    roll = want.groupby("g")["v"].rolling(3, min_periods=1)
+    for name, series in (("s", roll.sum()), ("a", roll.mean()),
+                         ("mn", roll.min()), ("mx", roll.max()),
+                         ("c", roll.count())):
+        got = out[name].to_numpy()
+        exp = series.reset_index(level=0, drop=True).sort_index().to_numpy()
+        # align: both frames sorted by (g, t)
+        exp = (want.assign(x=series.reset_index(level=0, drop=True))
+               .sort_values(["g", "t"])["x"].to_numpy())
+        assert np.allclose(got.astype(float), exp), name
+
+
+def test_rows_between_following_and_unbounded(session):
+    from spark_tpu.window import Window
+    pdf = pd.DataFrame({"g": [0, 0, 0, 1, 1],
+                        "t": [1, 2, 3, 1, 2],
+                        "v": [1.0, 2.0, 4.0, 8.0, 16.0]})
+    session.register_table("wf_fol", pdf)
+    w1 = Window.partition_by(col("g")).order_by(col("t")) \
+        .rows_between(0, 1)       # current + next
+    w2 = Window.partition_by(col("g")).order_by(col("t")) \
+        .rows_between(0, Window.unboundedFollowing)  # running suffix
+    out = (session.table("wf_fol").select(
+        col("g"), col("t"),
+        F.sum(col("v")).over(w1).alias("nxt"),
+        F.sum(col("v")).over(w2).alias("suf"),
+    ).to_pandas().sort_values(["g", "t"]).reset_index(drop=True))
+    assert out["nxt"].tolist() == [3.0, 6.0, 4.0, 24.0, 16.0]
+    assert out["suf"].tolist() == [7.0, 6.0, 4.0, 24.0, 16.0]
+
+
+def test_range_between_value_offsets(session):
+    """RANGE BETWEEN 10 PRECEDING AND CURRENT ROW: value-space frame
+    incl. peers and gaps."""
+    from spark_tpu.window import Window
+    pdf = pd.DataFrame({
+        "g": [0, 0, 0, 0, 0],
+        "t": np.array([0, 5, 14, 15, 40], np.int64),
+        "v": [1.0, 2.0, 4.0, 8.0, 16.0]})
+    session.register_table("wf_range", pdf)
+    w = Window.partition_by(col("g")).order_by(col("t")) \
+        .range_between(-10, 0)
+    out = (session.table("wf_range").select(
+        col("t"), F.sum(col("v")).over(w).alias("s"))
+        .to_pandas().sort_values("t").reset_index(drop=True))
+    # frames: t=0 -> {0}; t=5 -> {0,5}; t=14 -> {5,14}; t=15 -> {5,14,15};
+    # t=40 -> {40}
+    assert out["s"].tolist() == [1.0, 3.0, 6.0, 14.0, 16.0]
+
+
+def test_sql_window_frame_clause(session):
+    pdf = pd.DataFrame({"g": [0, 0, 0, 1, 1],
+                        "t": [1, 2, 3, 1, 2],
+                        "v": [1.0, 2.0, 4.0, 8.0, 16.0]})
+    session.register_table("wf_sql", pdf)
+    out = session.sql(
+        "SELECT g, t, sum(v) OVER (PARTITION BY g ORDER BY t "
+        "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s "
+        "FROM wf_sql ORDER BY g, t").to_pandas()
+    assert out["s"].tolist() == [1.0, 3.0, 6.0, 8.0, 24.0]
+
+
+def test_window_frames_on_mesh(session):
+    """Sliding frames under the 8-shard mesh match single-chip."""
+    from spark_tpu.window import Window
+    pdf = _frame_pdf()
+    session.register_table("wf_mesh", pdf)
+    w = (Window.partition_by(col("g")).order_by(col("t"))
+         .rows_between(-2, 0))
+    build = lambda: (session.table("wf_mesh").select(
+        col("g"), col("t"), F.sum(col("v")).over(w).alias("s"))
+        .to_pandas().sort_values(["g", "t"]).reset_index(drop=True))
+    want = build()
+    try:
+        session.conf.set("spark_tpu.sql.mesh.size", 8)
+        got = build()
+    finally:
+        session.conf.set("spark_tpu.sql.mesh.size", 0)
+    assert np.allclose(got["s"], want["s"])
+
+
+def test_computed_partition_key_stays_clustered(session):
+    """A computed PARTITION BY key must hash-partition (projected key),
+    not degrade to AllTuples (round-4 VERDICT weak #8)."""
+    from spark_tpu.window import Window
+    pdf = _frame_pdf()
+    session.register_table("wf_ck", pdf)
+    w = Window.partition_by((col("g") % 3).alias("gb")) \
+        .order_by(col("t"))
+    df = session.table("wf_ck").select(
+        col("g"), col("t"), F.sum(col("v")).over(w).alias("s"))
+    # plan-level: the WindowExec must NOT require AllTuples
+    from spark_tpu.plan import physical as P
+    qe = df._qe()
+
+    def find_window(n):
+        if isinstance(n, P.WindowExec):
+            return n
+        for c in n.children:
+            f = find_window(c)
+            if f is not None:
+                return f
+        return None
+
+    wx = find_window(qe.executed_plan)
+    assert wx is not None
+    dists = wx.required_child_distributions()
+    assert not isinstance(dists[0], P.AllTuples), dists
+    # and parity between mesh and single-chip
+    want = df.to_pandas().sort_values(["g", "t"]).reset_index(drop=True)
+    try:
+        session.conf.set("spark_tpu.sql.mesh.size", 8)
+        got = df.to_pandas().sort_values(["g", "t"]).reset_index(drop=True)
+    finally:
+        session.conf.set("spark_tpu.sql.mesh.size", 0)
+    assert np.allclose(got["s"], want["s"])
+
+
+def test_range_frame_with_filtered_rows_and_nulls(session):
+    """Code-review r5: RANGE-frame binary search must survive dead
+    (filtered) rows at the sorted tail and NULL order keys — both used
+    to break the in-segment monotonicity the search assumes."""
+    from spark_tpu.window import Window
+    pdf = pd.DataFrame({
+        "g": [0, 0, 0, 0, 0, 0],
+        "t": np.array([1, 2, 5, 14, 15, 40], np.float64),
+        "v": [100.0, 200.0, 1.0, 2.0, 4.0, 8.0]})
+    pdf.loc[5, "t"] = np.nan  # NULL order key row (t=40 -> NULL)
+    session.register_table("wf_dead", pdf)
+    w = Window.partition_by(col("g")).order_by(col("t")) \
+        .range_between(-10, 0)
+    out = (session.table("wf_dead")
+           .filter(col("v") < 50.0)  # drops t=1,2 -> dead sorted rows
+           .select(col("t"), F.sum(col("v")).over(w).alias("s"))
+           .to_pandas())
+    by_t = {None if pd.isna(t) else t: s
+            for t, s in zip(out["t"], out["s"])}
+    # live rows: t=5 {5}; t=14 {5,14}; t=15 {5,14,15}; NULL -> its peer
+    # group of NULL rows {8.0}
+    assert by_t[5.0] == 1.0
+    assert by_t[14.0] == 3.0
+    assert by_t[15.0] == 7.0
+    assert by_t[None] == 8.0
+
+
+def test_frame_without_order_by_rejected(session):
+    from spark_tpu.expr import AnalysisError
+    from spark_tpu.window import Window
+    pdf = pd.DataFrame({"g": [0, 0, 1], "v": [1.0, 2.0, 4.0]})
+    session.register_table("wf_noord", pdf)
+    w = Window.partition_by(col("g")).rows_between(-1, 0)
+    with pytest.raises(AnalysisError):
+        (session.table("wf_noord")
+         .select(F.sum(col("v")).over(w).alias("s")).to_pandas())
