@@ -141,6 +141,28 @@ STREAMING_CHUNK_ROWS = register(
         "the way the reference's row-iterator pipeline does. (1<<26 "
         "chunks faulted the v5e runtime on wide-domain aggregates.)")
 
+SKEW_JOIN_ENABLED = register(
+    "spark_tpu.sql.adaptive.skewJoin.enabled", True,
+    doc="When a shuffle join's exchange overflows with one receive "
+        "bucket holding more than skewJoin.factor x the mean rows per "
+        "shard, re-plan the join as broadcast (all_gather the build "
+        "side) instead of growing buckets — no exchange, no skew. The "
+        "OptimizeSkewedJoin.scala:56 + DynamicJoinSelection.scala:1 "
+        "analog, expressed as strategy re-planning rather than "
+        "partition splitting (static SPMD shapes make the broadcast "
+        "form strictly simpler).")
+
+SKEW_JOIN_FACTOR = register(
+    "spark_tpu.sql.adaptive.skewJoin.factor", 4.0,
+    doc="Skew threshold: max-bucket rows / (total rows / shards) above "
+        "which a shuffle join re-plans (skewJoin.enabled).")
+
+SKEW_BROADCAST_BYTES = register(
+    "spark_tpu.sql.adaptive.skewJoin.broadcastThreshold", 256 << 20,
+    doc="Max measured build-side bytes for the skew-triggered broadcast "
+        "re-plan (larger than autoBroadcastJoinThreshold: paying a "
+        "bigger all_gather beats an unboundedly skewed exchange).")
+
 WAREHOUSE_DIR = register(
     "spark_tpu.sql.warehouse.dir", "spark-warehouse",
     doc="Directory for persistent tables (CREATE TABLE / INSERT INTO): "

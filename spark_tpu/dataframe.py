@@ -51,6 +51,16 @@ class DataFrame:
         from .window import extract_window_exprs
         return extract_window_exprs(self.plan, exprs)
 
+    def with_watermark(self, col_name: str, delay: str) -> "DataFrame":
+        """Event-time watermark (reference: Dataset.withWatermark +
+        WatermarkTracker.scala:1): rows older than max(event_time) -
+        delay drop; closed windows evict/emit in append mode."""
+        from .expr_fns import parse_duration_us
+        return self._with(L.Watermark(self.plan, col_name,
+                                      parse_duration_us(delay)))
+
+    withWatermark = with_watermark
+
     def filter(self, condition: Expression) -> "DataFrame":
         return self._with(L.Filter(self.plan, condition))
 

@@ -400,3 +400,119 @@ def sort_aggregate(key_vecs: Sequence[Vec],
         else:
             key_valids.append(None)
     return key_arrays, key_valids, accs, occupied_cnt > 0, total_groups
+
+
+# ---------------------------------------------------------------------------
+# Positional aggregates: percentile/median/collect_list/collect_set
+# (reference: ApproximatePercentile.scala:1 / Percentile.scala /
+# collect.scala — ObjectHashAggregate's serialized per-group state
+# becomes ONE device sort by (group keys, value) + segmented positional
+# gathers; list outputs compact into offsets-encoded array columns)
+# ---------------------------------------------------------------------------
+
+def positional_sort(key_vecs: Sequence[Vec], value_vec: Vec, sel,
+                    capacity: int):
+    """Sort rows by (liveness, group keys, value-null-last, value).
+    Returns (values_sorted, value_valid_sorted, starts, gid, start_pos,
+    total_groups, group_occupied). Group ORDER depends only on the keys,
+    so several positional sorts (different value children) and a
+    sort_aggregate over the same keys all align group-for-group."""
+    operands = []
+    invalid = jnp.zeros((capacity,), jnp.int32) if sel is None else \
+        (~sel).astype(jnp.int32)
+    operands.append(invalid)
+    for vec in key_vecs:
+        if vec.validity is not None:
+            operands.append((~vec.validity).astype(jnp.int8))
+        operands.append(vec.data)
+    vinvalid = jnp.zeros((capacity,), jnp.int8) \
+        if value_vec.validity is None else \
+        (~value_vec.validity).astype(jnp.int8)
+    operands.append(vinvalid)  # null values sort to the group tail
+    operands.append(value_vec.data)
+    num_keys = len(operands)
+    operands.append(jnp.arange(capacity, dtype=jnp.int32))
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
+    valid_sorted = sorted_ops[0] == 0
+    values_sorted = sorted_ops[-2]
+    vvalid_sorted = (sorted_ops[-3] == 0) & valid_sorted
+
+    diff = jnp.zeros((capacity,), jnp.bool_)
+    i = 1
+    for vec in key_vecs:
+        if vec.validity is not None:
+            op = sorted_ops[i]
+            diff = diff | (op != jnp.roll(op, 1))
+            i += 1
+        op = sorted_ops[i]
+        diff = diff | (op != jnp.roll(op, 1))
+        i += 1
+    first = jnp.arange(capacity) == 0
+    starts = (first | diff) & valid_sorted
+    total_groups = jnp.sum(starts.astype(jnp.int32))
+    gid = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    gid = jnp.where(valid_sorted, gid, capacity)
+
+    pos = jnp.arange(capacity, dtype=jnp.int32)
+    sidx = jnp.where(starts, jnp.clip(gid, 0, capacity), capacity)
+    # GROUP-indexed first-row position (slot g -> group g's start)
+    gstart = jnp.zeros((capacity,), jnp.int32).at[sidx].set(
+        pos, mode="drop")
+    # per-ROW segment-start position (running max of start markers)
+    row_start = jax.lax.cummax(jnp.where(starts, pos, jnp.int32(0)))
+    return (values_sorted, vvalid_sorted, starts, gid, gstart,
+            row_start, total_groups, sorted_ops)
+
+
+def positional_percentile(values_sorted, vvalid_sorted, gid, gstart,
+                          num_segments: int, q: float, capacity: int):
+    """Exact per-group percentile with linear interpolation (the
+    reference's Percentile): values of each group sit contiguously with
+    nulls at the tail, so the q-quantile is two gathers + a lerp.
+    `gstart` is GROUP-indexed (slot g -> group g's first sorted row)."""
+    cnt = jnp.zeros((num_segments + 1,), jnp.int32).at[
+        jnp.clip(gid, 0, num_segments)].add(
+        vvalid_sorted.astype(jnp.int32), mode="drop")[:num_segments]
+    gstart = gstart[:num_segments]
+    vals = values_sorted.astype(jnp.float64)
+    idx = (cnt - 1).astype(jnp.float64) * q
+    lo = jnp.clip(jnp.floor(idx).astype(jnp.int32), 0, None)
+    hi = jnp.clip(jnp.ceil(idx).astype(jnp.int32), 0, None)
+    safe = jnp.clip(gstart, 0, capacity - 1)
+    v_lo = jnp.take(vals, jnp.clip(safe + lo, 0, capacity - 1))
+    v_hi = jnp.take(vals, jnp.clip(safe + hi, 0, capacity - 1))
+    frac = idx - lo.astype(jnp.float64)
+    out = v_lo + (v_hi - v_lo) * frac
+    return out, cnt > 0
+
+
+def positional_collect(values_sorted, vvalid_sorted, gid, row_start,
+                       num_segments: int, distinct: bool, capacity: int):
+    """collect_list / collect_set: compact each group's (optionally
+    deduplicated) valid values into an offsets-encoded list column.
+    `row_start` is the PER-ROW segment-start position. Returns
+    (data[cap], offsets[num_segments+1])."""
+    keep = vvalid_sorted
+    if distinct:
+        same_prev = (jnp.roll(values_sorted, 1) == values_sorted) & \
+            (jnp.roll(gid, 1) == gid) & \
+            (jnp.arange(capacity) != 0)
+        keep = keep & ~(same_prev & vvalid_sorted &
+                        jnp.roll(vvalid_sorted, 1))
+    kcnt = jnp.zeros((num_segments + 1,), jnp.int32).at[
+        jnp.clip(gid, 0, num_segments)].add(
+        keep.astype(jnp.int32), mode="drop")[:num_segments]
+    new_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(kcnt)]).astype(jnp.int32)
+    ck = jnp.cumsum(keep.astype(jnp.int32))
+    # rank of each kept row within its group's kept values
+    ck_at_start = jnp.take(ck, row_start) - jnp.take(
+        keep.astype(jnp.int32), row_start)
+    rank = ck - ck_at_start - 1
+    target = jnp.where(
+        keep,
+        jnp.take(new_off, jnp.clip(gid, 0, num_segments)) + rank,
+        capacity)
+    data = jnp.zeros((capacity,), values_sorted.dtype).at[
+        target].set(values_sorted, mode="drop")
+    return data, new_off

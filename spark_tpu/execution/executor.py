@@ -27,6 +27,10 @@ from ..plan.optimizer import default_optimizer
 from ..plan.planner import plan_physical
 
 
+class _ReplanRequest(Exception):
+    """Internal: restart execution after a strategy re-plan."""
+
+
 class QueryExecution:
     def __init__(self, session, logical: L.LogicalPlan):
         self.session = session
@@ -37,6 +41,9 @@ class QueryExecution:
         self.phase_times: Dict[str, float] = {}
         self.last_metrics: Dict[str, int] = {}
         self.spilled_partial_rows: Optional[int] = None
+        # adaptive strategy re-plans (DynamicJoinSelection.scala:1):
+        # {join_tag: strategy}, applied by executed_plan on re-plan
+        self._join_overrides: Dict[str, str] = {}
 
     def _activate_conf(self) -> None:
         """Apply session conf to analysis-time globals (the reference's
@@ -136,8 +143,9 @@ class QueryExecution:
     def executed_plan(self) -> P.PhysicalPlan:
         if self._executed is None:
             t0 = time.perf_counter()
-            self._executed = plan_physical(self.optimized_plan,
-                                           self.session.conf)
+            self._executed = plan_physical(
+                self.optimized_plan, self.session.conf,
+                join_strategy_overrides=self._join_overrides or None)
             self.phase_times["planning"] = time.perf_counter() - t0
         return self._executed
 
@@ -407,12 +415,21 @@ class QueryExecution:
         capacity surface a `join_overflow_<tag>` flag plus the true row
         total in `join_rows_<tag>`; the loop below re-jits those joins
         with a sufficient static capacity (the AQE-style stats->re-plan
-        host loop, `AdaptiveSparkPlanExec.scala:64`)."""
+        host loop, `AdaptiveSparkPlanExec.scala:64`). A skewed shuffle
+        join raises _ReplanRequest instead: the physical plan rebuilds
+        with the join forced to broadcast and execution restarts."""
         from ..columnar import bucket_capacity
         from ..parallel.mesh import get_mesh
         self._activate_conf()
         self.session._exec_depth += 1
         try:
+            for _replan in range(4):
+                try:
+                    return self._execute_batch_inner()
+                except _ReplanRequest:
+                    self._executed = None  # re-plan with _join_overrides
+            # replan budget exhausted: finish with capacity growth only
+            self._no_more_replans = True
             return self._execute_batch_inner()
         finally:
             self.session._exec_depth -= 1
@@ -512,6 +529,9 @@ class QueryExecution:
                     elif k.startswith("exch_overflow_"):
                         tag = k[len("exch_overflow_"):]
                         mx = int(metrics[f"exch_max_{tag}"])
+                        if self._maybe_skew_replan(root, tag, metrics,
+                                                   mesh):
+                            raise _ReplanRequest()
                         self._set_exchange_cap(root, tag,
                                                bucket_capacity(max(mx, 8)))
                     else:
@@ -546,6 +566,60 @@ class QueryExecution:
             self.session._data_cache[fp] = batch.to_arrow()
         self._log_event(root)
         return batch, flags, metrics
+
+    def _maybe_skew_replan(self, root: P.PhysicalPlan, exch_tag: str,
+                           metrics: Dict, mesh) -> bool:
+        """On a skewed shuffle-join exchange (max bucket > factor x mean
+        rows/shard), force the join to broadcast and request a re-plan
+        — the `OptimizeSkewedJoin.scala:56` / `DynamicJoinSelection`
+        move, expressed as strategy re-selection. Returns True when an
+        override was recorded."""
+        conf = self.session.conf
+        if getattr(self, "_no_more_replans", False):
+            return False  # budget exhausted: capacity growth only
+        if mesh is None or not bool(conf.get(
+                "spark_tpu.sql.adaptive.skewJoin.enabled")):
+            return False
+        n = int(mesh.devices.size)
+        factor = float(conf.get("spark_tpu.sql.adaptive.skewJoin.factor"))
+        limit = int(conf.get(
+            "spark_tpu.sql.adaptive.skewJoin.broadcastThreshold"))
+        mx = int(metrics.get(f"exch_max_{exch_tag}", 0))
+        rows = int(metrics.get(f"exch_rows_{exch_tag}", 0))
+        # exch_max is the max per-(src,dst) bucket count; a uniform
+        # spread puts rows/n^2 in each bucket
+        if rows <= 0 or mx * n * n <= factor * rows:
+            return False  # overflow without skew: capacity growth wins
+
+        # find the join fed by this exchange
+        hit = []
+
+        def walk(node, parent):
+            for c in node.children:
+                walk(c, node)
+            if isinstance(node, P.ExchangeExec) and node.tag == exch_tag \
+                    and isinstance(parent, P.JoinExec):
+                hit.append(parent)
+
+        walk(root, None)
+        if not hit:
+            return False
+        join = hit[0]
+        if join.strategy != "shuffle" or join.how in ("right", "full") \
+                or join.tag in self._join_overrides:
+            return False
+        # measured build-side size: its own exchange's routed rows
+        build = join.children[1]
+        build_rows = None
+        if isinstance(build, P.ExchangeExec):
+            build_rows = metrics.get(f"exch_rows_{build.tag}")
+        if build_rows is None:
+            return False  # no measurement -> keep capacity growth
+        width = 8 * max(1, len(build.schema().fields))
+        if int(build_rows) * width > limit:
+            return False
+        self._join_overrides[join.tag] = "broadcast"
+        return True
 
     def _log_event(self, root: P.PhysicalPlan) -> None:
         """Append one JSON line per execution when eventLog.dir is set

@@ -442,6 +442,8 @@ def try_stream_aggregate_spill(agg: "P.HashAggregateExec", conf,
         return None
     if any(a.func.uses_row_base for a in agg.agg_exprs):
         return None  # packed-position aggs need whole-input row order
+    if any(getattr(a.func, "positional", False) for a in agg.agg_exprs):
+        return None  # no accumulator decomposition: whole-input only
     found = find_streamable_chain(agg)
     if found is None:
         return None
@@ -515,6 +517,8 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
 
     if agg.mode != "partial":
         return None
+    if any(getattr(a.func, "positional", False) for a in agg.agg_exprs):
+        return None  # no accumulator decomposition: whole-input only
     # mesh streaming is unary-only: a streamed join would need the build
     # replicated per shard — future work
     found = find_streamable_chain(agg, allow_joins=False)
@@ -647,6 +651,8 @@ def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
                          cache: Optional[dict] = None) -> Optional[Batch]:
     if agg.mode != "complete":
         return None
+    if any(getattr(a.func, "positional", False) for a in agg.agg_exprs):
+        return None  # no accumulator decomposition: whole-input only
     found = find_streamable_chain(agg)
     if found is None:
         return None

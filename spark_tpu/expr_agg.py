@@ -28,7 +28,7 @@ import numpy as np
 
 from . import types as T
 from .columnar import Batch
-from .expr import Expression, Vec, cast_vec, _and_valid
+from .expr import AnalysisError, Expression, Vec, cast_vec, _and_valid
 
 
 @dataclass(frozen=True)
@@ -828,3 +828,71 @@ class AggExpr:
 
     def __repr__(self):
         return f"{self.func!r} AS {self.out_name}"
+
+
+# ---------------------------------------------------------------------------
+# Positional aggregates (reference: Percentile.scala,
+# ApproximatePercentile.scala:1, collect.scala). They have no flat
+# accumulator decomposition — the engine computes them in ONE complete-
+# mode pass via a (group keys, value) device sort (the ObjectHashAggregate
+# seat); under a mesh they run per shard behind a hash-clustered exchange.
+# ---------------------------------------------------------------------------
+
+class _PositionalAgg(AggregateFunction):
+    positional = True
+
+    def accumulators(self, schema):
+        raise AnalysisError(
+            f"{type(self).__name__} has no accumulator decomposition "
+            "(positional aggregates run in one complete pass)")
+
+    def update(self, batch, sel):
+        raise AnalysisError(f"{type(self).__name__}.update unreachable")
+
+    def finalize(self, accs, schema):
+        raise AnalysisError(f"{type(self).__name__}.finalize unreachable")
+
+
+class Percentile(_PositionalAgg):
+    """Exact percentile with linear interpolation; nulls ignored."""
+
+    def __init__(self, child, q: float):
+        super().__init__(child)
+        if not (0.0 <= float(q) <= 1.0):
+            raise AnalysisError(
+                f"percentile fraction must be in [0, 1], got {q}")
+        self.q = float(q)
+
+    def result_type(self, schema):
+        return T.DOUBLE
+
+    def __repr__(self):
+        return f"percentile({self.child!r}, {self.q})"
+
+
+class Median(Percentile):
+    def __init__(self, child):
+        super().__init__(child, 0.5)
+
+    def __repr__(self):
+        return f"median({self.child!r})"
+
+
+class CollectList(_PositionalAgg):
+    """collect_list: the group's non-null values as an array (order is
+    value-sorted — a valid instance of the reference's unspecified
+    order)."""
+
+    distinct = False
+    _name = "collect_list"
+
+    def result_type(self, schema):
+        return T.ArrayType(self.child.dtype(schema))
+
+    def __repr__(self):
+        return f"{self._name}({self.child!r})"
+
+
+class CollectSet(CollectList):
+    distinct = True
+    _name = "collect_set"

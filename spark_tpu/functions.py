@@ -500,3 +500,43 @@ def explode(c):
 
 def explode_outer(c):
     return _A.Explode(_expr(c), outer=True)
+
+
+# -- positional aggregates (Percentile.scala / collect.scala) ---------------
+
+from .expr_agg import (CollectList as _CollectList,  # noqa: E402
+                       CollectSet as _CollectSet, Median as _Median,
+                       Percentile as _Percentile)
+
+
+def percentile(e, q):
+    return _Percentile(_expr(e), q)
+
+
+def percentile_approx(e, q, accuracy=None):
+    """Exact percentile (better than the required accuracy bound of the
+    reference's ApproximatePercentile.scala:1 — the device sort makes
+    exact as cheap as approximate)."""
+    return _Percentile(_expr(e), q)
+
+
+approx_percentile = percentile_approx
+
+
+def median(e):
+    return _Median(_expr(e))
+
+
+def collect_list(e):
+    return _CollectList(_expr(e))
+
+
+def collect_set(e):
+    return _CollectSet(_expr(e))
+
+
+def window(ts, duration):
+    """Tumbling event-time window start (reference: TimeWindow); used as
+    a streaming group key with with_watermark for event-time
+    aggregation."""
+    return _X.TumbleWindow(_expr(ts), duration)

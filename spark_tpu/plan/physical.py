@@ -457,6 +457,10 @@ class HashAggregateExec(UnaryExec):
         base = self._base_schema()
         sel = batch.selection
 
+        if any(getattr(a.func, "positional", False)
+               for a in self.agg_exprs):
+            return self._compute_positional(ctx, batch, base)
+
         key_vecs = [g.eval(batch) for g in self.group_exprs]
         if self.mode == "final":
             specs = [a.func.accumulators(base) for a in self.agg_exprs]
@@ -534,6 +538,84 @@ class HashAggregateExec(UnaryExec):
                     getattr(a.func, "output_dictionary", None))
         ctx.add_metric(f"agg_groups", jnp.sum(occupied.astype(jnp.int32)))
         return Batch(cols, occupied)
+
+    def _compute_positional(self, ctx, batch: Batch, base) -> Batch:
+        """Aggregates with positional functions (percentile/median/
+        collect_list/collect_set — ApproximatePercentile.scala:1,
+        collect.scala): one complete pass over a (group keys, value)
+        sort per distinct value child. Regular functions in the same
+        SELECT ride a sort_aggregate over the SAME key order, so all
+        output columns align group-for-group."""
+        from ..expr import cast_vec
+        if self.mode != "complete":
+            raise AnalysisError(
+                "positional aggregates (percentile/median/collect_*) "
+                "have no partial/final decomposition")
+        sel = batch.selection
+        cap = batch.capacity
+        key_vecs = [g.eval(batch) for g in self.group_exprs]
+        num_segments = cap
+
+        regular = [(i, a) for i, a in enumerate(self.agg_exprs)
+                   if not getattr(a.func, "positional", False)]
+        specs = [a.func.accumulators(base) for _, a in regular]
+        contribs = [a.func.update(batch, sel) for _, a in regular]
+        (key_arrays, key_valids, accs, occupied,
+         _total) = agg_kernels.sort_aggregate(
+            key_vecs, contribs, specs, sel, cap,
+            num_segments=num_segments)
+        if not self.group_exprs:
+            occupied = jnp.ones((1,), jnp.bool_) \
+                if num_segments == 1 else \
+                jnp.arange(num_segments) < 1
+            key_arrays, key_valids = [], []
+
+        out_cols: Dict[str, Column] = {}
+        for g, vec, arr, kv in zip(self.group_exprs, key_vecs,
+                                   key_arrays, key_valids):
+            out_cols[g.name()] = Column(arr, vec.dtype, kv,
+                                        vec.dictionary)
+
+        results: Dict[int, Column] = {}
+        for j, (_, a) in enumerate(regular):
+            data, validity = a.func.device_finalize(accs[j], base)
+            results[regular[j][0]] = Column(
+                data, a.func.result_type(base), validity,
+                getattr(a.func, "output_dictionary", None))
+
+        from ..expr_agg import CollectList, Percentile
+        sorts = {}  # child repr -> positional_sort outputs
+        for i, a in enumerate(self.agg_exprs):
+            if not getattr(a.func, "positional", False):
+                continue
+            f = a.func
+            vec = f.child.eval(batch)
+            if isinstance(f, Percentile):
+                vec = cast_vec(vec, T.DOUBLE)
+            skey = (repr(f.child), isinstance(f, Percentile))
+            if skey not in sorts:
+                sorts[skey] = agg_kernels.positional_sort(
+                    key_vecs, vec, sel, cap)
+            (vals_s, vvalid_s, _starts, gid, gstart, row_start, _tg,
+             _ops) = sorts[skey]
+            if isinstance(f, Percentile):
+                out, ok = agg_kernels.positional_percentile(
+                    vals_s, vvalid_s, gid, gstart, num_segments,
+                    f.q, cap)
+                results[i] = Column(out, T.DOUBLE, ok & occupied)
+            else:
+                data, offs = agg_kernels.positional_collect(
+                    vals_s, vvalid_s, gid, row_start, num_segments,
+                    f.distinct, cap)
+                results[i] = Column(
+                    data, T.ArrayType(vec.dtype), occupied,
+                    vec.dictionary, offsets=offs)
+
+        for i, a in enumerate(self.agg_exprs):
+            out_cols[a.out_name] = results[i]
+        ctx.add_metric("agg_groups",
+                       jnp.sum(occupied.astype(jnp.int32)))
+        return Batch(out_cols, occupied)
 
     def _occupancy_reuse(self, batch) -> Optional[Tuple[int, int]]:
         """(i, j) of an accumulator whose contribution equals the
@@ -689,8 +771,19 @@ class HashAggregateExec(UnaryExec):
         if self.mode in ("complete", "final"):
             if not self.group_exprs:
                 return [AllTuples()]
-            return [ClusteredDistribution(tuple(g.name()
-                                                for g in self.group_exprs))]
+            names = []
+            for g in self.group_exprs:
+                e = g
+                while isinstance(e, Alias):
+                    e = e.child
+                from ..expr import ColumnRef
+                if not isinstance(e, ColumnRef):
+                    # a computed group key has no child column to hash
+                    # (mesh positional aggregates reach complete mode
+                    # directly): gather instead of a broken exchange
+                    return [AllTuples()]
+                names.append(e.name())
+            return [ClusteredDistribution(tuple(names))]
         return [UnspecifiedDistribution()]
 
     def simple_string(self):

@@ -25,12 +25,30 @@ from . import logical as L
 from . import physical as P
 
 
-def plan_physical(plan: L.LogicalPlan, conf: Conf) -> P.PhysicalPlan:
+def plan_physical(plan: L.LogicalPlan, conf: Conf,
+                  join_strategy_overrides: Optional[dict] = None
+                  ) -> P.PhysicalPlan:
+    """`join_strategy_overrides` ({join_tag: strategy}) is the adaptive
+    re-planner's seam (DynamicJoinSelection.scala:1): join tags depend
+    only on join order in the converted tree, so they are stable across
+    re-plans of the same optimized plan — overrides apply BEFORE
+    exchange insertion so requirements re-derive for the new strategy."""
     n = max(1, int(conf.get("spark_tpu.sql.mesh.size")))
     phys = _convert(plan, conf, n)
+    if join_strategy_overrides:
+        _assign_join_tags(phys)
+        _apply_strategy_overrides(phys, join_strategy_overrides)
     phys = ensure_requirements(phys, conf, n)
     _assign_join_tags(phys)
     return phys
+
+
+def _apply_strategy_overrides(plan: P.PhysicalPlan,
+                              overrides: dict) -> None:
+    for c in plan.children:
+        _apply_strategy_overrides(c, overrides)
+    if isinstance(plan, P.JoinExec) and plan.tag in overrides:
+        plan.strategy = overrides[plan.tag]
 
 
 def _assign_join_tags(plan: P.PhysicalPlan) -> None:
@@ -127,7 +145,13 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
         rows = estimate_rows(plan.child)
         if rows is not None:
             est = min(est, max(1, rows))
-        if n <= 1:
+        positional = any(getattr(a.func, "positional", False)
+                         for a in plan.agg_exprs)
+        if n <= 1 or positional:
+            # positional aggregates (percentile/collect_*) have no
+            # partial/final decomposition: one complete pass per shard
+            # behind the hash-clustered (or AllTuples) exchange the
+            # complete mode's requirements already demand
             return P.HashAggregateExec(child, plan.group_exprs,
                                        plan.agg_exprs, mode="complete",
                                        est_groups=est)
@@ -150,6 +174,8 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
     if isinstance(plan, L.WindowPlan):
         return P.WindowExec(_convert(plan.child, conf, n), plan.wexprs,
                             plan.schema())
+    if isinstance(plan, L.Watermark):
+        return _convert(plan.child, conf, n)  # batch: passthrough
     if isinstance(plan, L.Generate):
         return P.GenerateExec(_convert(plan.child, conf, n),
                               plan.gen_expr, plan.out_name,

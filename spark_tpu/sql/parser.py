@@ -633,6 +633,29 @@ class Parser:
             y = self.parse_expr()
             self.expect_op(")")
             return _AggCall(self._AGGS2[name](x, y))
+        if name in ("PERCENTILE", "PERCENTILE_APPROX",
+                    "APPROX_PERCENTILE"):
+            from ..expr_agg import Percentile
+            e = self.parse_expr()
+            self.expect_op(",")
+            q = self.parse_expr()
+            if not isinstance(q, Literal):
+                raise ParseError(f"{name} fraction must be a literal")
+            if self.eat_op(","):
+                self.parse_expr()  # accuracy: exact anyway
+            self.expect_op(")")
+            return _AggCall(Percentile(e, float(q.value)))
+        if name == "MEDIAN":
+            from ..expr_agg import Median
+            e = self.parse_expr()
+            self.expect_op(")")
+            return _AggCall(Median(e))
+        if name in ("COLLECT_LIST", "COLLECT_SET", "ARRAY_AGG"):
+            from ..expr_agg import CollectList, CollectSet
+            e = self.parse_expr()
+            self.expect_op(")")
+            cls = CollectSet if name == "COLLECT_SET" else CollectList
+            return _AggCall(cls(e))
         if name in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
             self.expect_op(")")
             return _RankingCall(name.lower(), None, 0, None)

@@ -132,16 +132,24 @@ class StreamingQuery:
         self._state_dir = os.path.join(checkpoint_dir, "state")
         os.makedirs(self._state_dir, exist_ok=True)
         self._agg = self._find_aggregate(plan)
-        if self._agg is not None and output_mode == "append":
+        self._watermark = self._find_watermark(plan)
+        self._event_time = (self._agg is not None
+                            and self._watermark is not None)
+        if self._agg is not None and output_mode == "append" \
+                and not self._event_time:
             # the reference rejects append-without-watermark for
             # aggregations at analysis time; silently re-emitting every
             # group each trigger would duplicate sink rows
             raise ValueError(
-                "outputMode='append' on a streaming aggregation is not "
-                "supported (no watermark support); use 'complete'")
+                "outputMode='append' on a streaming aggregation needs "
+                "a watermark (with_watermark) so closed windows can be "
+                "emitted exactly once; use 'complete' otherwise")
         self._results: List[pd.DataFrame] = []
         self._tables = None      # carried aggregate state (device)
         self._prep = None
+        # event-time path: host state table + watermark (us)
+        self._evstate: Optional[pd.DataFrame] = None
+        self._wm: int = -(1 << 62)
         self._recover()
 
     # -- plan shape ---------------------------------------------------------
@@ -164,16 +172,36 @@ class StreamingQuery:
             raise ValueError("multiple streaming aggregates unsupported")
         return aggs[0] if aggs else None
 
+    @staticmethod
+    def _find_watermark(plan: L.LogicalPlan):
+        """(col_name, delay_us) of the single Watermark node, if any."""
+        found = []
+
+        def walk(n):
+            if isinstance(n, L.Watermark):
+                found.append((n.col_name, n.delay_us))
+            for c in n.children:
+                walk(c)
+
+        walk(plan)
+        return found[0] if found else None
+
     # -- recovery -----------------------------------------------------------
 
     def _recover(self) -> None:
         """Restart semantics: resume state at the last COMMITTED batch;
         a planned-but-uncommitted offset entry will re-run over its
         logged range (idempotent because state is versioned)."""
-        last_commit, _ = self.commit_log.latest()
+        last_commit, payload = self.commit_log.latest()
         self._committed_batch = -1 if last_commit is None else last_commit
         if self._agg is not None and last_commit is not None:
-            self._load_state(last_commit)
+            if self._event_time:
+                self._wm = int((payload or {}).get("wm", self._wm))
+                p = self._event_state_path(last_commit)
+                if os.path.exists(p):
+                    self._evstate = pd.read_parquet(p)
+            else:
+                self._load_state(last_commit)
 
     def _state_path(self, batch_id: int) -> str:
         return os.path.join(self._state_dir, f"v{batch_id}.npz")
@@ -204,10 +232,187 @@ class StreamingQuery:
                 i += 1
         self._tables = (cnt, accs)
 
+    # -- event-time (watermark) path ----------------------------------------
+
+    def _event_state_path(self, batch_id: int) -> str:
+        return os.path.join(self._state_dir, f"ev_v{batch_id}.parquet")
+
+    def _ensure_event_prep(self):
+        """Build the per-trigger PARTIAL-aggregate program: chain replay
+        + partial-mode compute (sort path, no domain bound needed). The
+        state store is a HOST table of group keys + raw accumulator
+        columns, merged per trigger with each accumulator's reduce op —
+        the versioned StateStore:101 analog with host RAM as the
+        backing tier."""
+        if getattr(self, "_ev_update", None) is not None:
+            return
+        self._ensure_prep_common()
+        import copy
+        from .plan.physical import ExecContext
+        agg = self._agg_exec
+        partial = copy.copy(agg)
+        partial.mode = "partial"
+        partial.est_groups = None
+        base = agg._base_schema()
+        self._ev_specs = [a.func.accumulators(base)
+                          for a in agg.agg_exprs]
+        self._ev_acc_cols = [
+            [agg._acc_col_name(i, j, spec)
+             for j, spec in enumerate(self._ev_specs[i])]
+            for i, a in enumerate(agg.agg_exprs)]
+        self._ev_group_cols = [g.name() for g in agg.group_exprs]
+        self._ev_base = base
+        # window duration for eviction (group key must include window())
+        from .expr_fns import TumbleWindow
+        from . import types as T
+        self._ev_window = None
+        for g in agg.group_exprs:
+            e = g
+            from .expr import Alias
+            while isinstance(e, Alias):
+                e = e.child
+            if isinstance(e, TumbleWindow):
+                self._ev_window = (g.name(), e.duration_us,
+                                   isinstance(e.dtype(base),
+                                              T.TimestampType))
+        if self.output_mode == "append" and self._ev_window is None:
+            raise ValueError(
+                "append mode needs an event-time window() group key so "
+                "closed windows can be emitted exactly once")
+
+        if any(a.func.uses_row_base for a in agg.agg_exprs):
+            raise ValueError(
+                "first/last are not supported in event-time streaming "
+                "aggregations (host-merged partials have no global row "
+                "order)")
+
+        def update(b):
+            ctx = ExecContext(self.session.conf)
+            for op in reversed(self._chain):
+                b = op.compute(ctx, [b])
+            return partial.compute(ctx, [b])
+
+        self._ev_update = jax.jit(update)
+
+
+    def _event_merge(self, state: Optional[pd.DataFrame],
+                     partial_pdf: pd.DataFrame) -> pd.DataFrame:
+        """Fold a trigger's partial table into the state with each
+        accumulator's reduce op (pure — replay safety)."""
+        if state is None or not len(state):
+            return partial_pdf
+        both = pd.concat([state, partial_pdf], ignore_index=True)
+        ops = {}
+        for specs, cols in zip(self._ev_specs, self._ev_acc_cols):
+            for spec, c in zip(specs, cols):
+                ops[c] = spec.reduce
+        return (both.groupby(self._ev_group_cols, dropna=False,
+                             sort=False, as_index=False).agg(ops))
+
+    def _event_finalize(self, state: pd.DataFrame) -> pd.DataFrame:
+        """Host finalize of (a subset of) the state table."""
+        agg = self._agg_exec
+        out = {c: state[c].to_numpy() for c in self._ev_group_cols}
+        for i, a in enumerate(agg.agg_exprs):
+            accs = [state[c].to_numpy() for c in self._ev_acc_cols[i]]
+            data, validity = a.func.finalize(accs, self._ev_base)
+            vals = pd.Series(np.asarray(data))
+            if validity is not None:
+                vals = vals.where(pd.Series(np.asarray(validity)))
+            out[a.out_name] = vals.to_numpy()
+        return pd.DataFrame(out)
+
+    def _run_batch_event(self, batch_id: int, table: pa.Table) -> None:
+        import pyarrow.compute as pc
+        self._ensure_event_prep()
+        col, delay = self._watermark
+        wm = self._wm
+        new_state = self._evstate
+        batch_max = None
+        if table.num_rows:
+            ts = table.column(col)
+            if pa.types.is_timestamp(ts.type):
+                ts_us = ts.cast(pa.timestamp("us")).cast(pa.int64())
+            else:
+                ts_us = ts.cast(pa.int64())
+            batch_max = pc.max(ts_us).as_py()
+            # late-data drop: strictly older than the CURRENT watermark
+            keep = pc.greater_equal(ts_us, pa.scalar(wm, pa.int64()))
+            table = table.filter(pc.fill_null(keep, False))
+        if table.num_rows:
+            pb = self._ev_update(self._batch_for(table))
+            partial_pdf = pb.to_arrow().to_pandas()
+            # normalize window keys to int64 microseconds for the host
+            # merge + eviction arithmetic
+            if self._ev_window is not None:
+                wcol = self._ev_window[0]
+                if str(partial_pdf[wcol].dtype).startswith("datetime"):
+                    partial_pdf[wcol] = pd.to_datetime(
+                        partial_pdf[wcol]).astype("datetime64[us]") \
+                        .astype("int64")
+            new_state = self._event_merge(new_state, partial_pdf)
+        if batch_max is not None:
+            wm = max(wm, batch_max - delay)
+
+        emitted = None
+        if self.output_mode == "append" and new_state is not None \
+                and len(new_state):
+            wcol, dur, _ = self._ev_window
+            closed = (new_state[wcol] + dur) <= wm
+            if closed.any():
+                emitted = new_state[closed]
+                new_state = new_state[~closed].reset_index(drop=True)
+
+        # persist BEFORE adopting (exactly-once on replay)
+        tmp = self._event_state_path(batch_id) + ".tmp"
+        (new_state if new_state is not None else
+         pd.DataFrame()).to_parquet(tmp)
+        os.replace(tmp, self._event_state_path(batch_id))
+        self._evstate = new_state
+        self._wm = wm
+
+        if self.output_mode == "complete":
+            if new_state is not None and len(new_state):
+                self._results.append(
+                    self._apply_above(self._event_finalize(new_state)))
+            else:
+                self._results.append(pd.DataFrame())
+        elif emitted is not None and len(emitted):
+            self._results.append(
+                self._apply_above(self._event_finalize(emitted)))
+
+    def _apply_above(self, pdf: pd.DataFrame) -> pd.DataFrame:
+        """Re-apply operators above the aggregate (HAVING/ORDER BY/...)
+        to a finalized host table."""
+        if not self._above or not len(pdf):
+            return self._restore_window_type(pdf)
+        from .plan.physical import ExecContext
+        out = Batch.from_arrow(pa.Table.from_pandas(
+            pdf, preserve_index=False))
+        ctx = ExecContext(self.session.conf)
+        for op in reversed(self._above):
+            out = op.compute(ctx, [out])
+        return self._restore_window_type(out.to_arrow().to_pandas())
+
+    def _restore_window_type(self, pdf: pd.DataFrame) -> pd.DataFrame:
+        # only TIMESTAMP event-time keys round-trip through int64 us
+        # (integer event-time columns stay integers — code-review r5)
+        if self._ev_window is not None and len(pdf) \
+                and self._ev_window[2]:
+            wcol = self._ev_window[0]
+            if wcol in pdf.columns and \
+                    np.issubdtype(pdf[wcol].dtype, np.integer):
+                pdf = pdf.assign(**{wcol: pd.to_datetime(
+                    pdf[wcol], unit="us")})
+        return pdf
+
     # -- execution ----------------------------------------------------------
 
-    def _ensure_prep(self):
-        if self._prep is not None or self._agg is None:
+    def _ensure_prep_common(self):
+        """Plan surgery shared by the device-table and event-time
+        paths: plan the swapped batch query, locate the aggregate, and
+        split the operator chain below/above it."""
+        if getattr(self, "_agg_exec", None) is not None:
             return
         from .io.sources import ArrowTableSource
         from .plan.planner import plan_physical
@@ -269,11 +474,17 @@ class StreamingQuery:
             chain.append(node)
             node = node.children[0]
         self._chain = chain
+
+    def _ensure_prep(self):
+        if self._prep is not None or self._agg is None:
+            return
+        self._ensure_prep_common()
+        agg_exec = self._agg_exec
         from .plan.physical import ExecContext
         probe = self._batch_for(self.stream.slice(0, 0))
         ctx = ExecContext(self.session.conf)
         replayed = probe
-        for op in reversed(chain):
+        for op in reversed(self._chain):
             replayed = op.compute(ctx, [replayed])
         from . import types as T
         base = agg_exec.child.schema()
@@ -329,7 +540,10 @@ class StreamingQuery:
                     return  # drained
                 self.offset_log.add(batch_id, {"start": start, "end": end})
             self._run_batch(batch_id, start, end)
-            self.commit_log.add(batch_id, {"ok": True})
+            payload = {"ok": True}
+            if self._event_time:
+                payload["wm"] = int(self._wm)
+            self.commit_log.add(batch_id, payload)
             self._committed_batch = batch_id
             self._prune(batch_id)
 
@@ -343,7 +557,14 @@ class StreamingQuery:
                 if f.isdigit() and int(f) < floor:
                     os.remove(os.path.join(log.path, f))
         for f in os.listdir(self._state_dir):
-            if f.startswith("v") and f.endswith(".npz"):
+            if f.startswith("ev_v") and f.endswith(".parquet"):
+                try:
+                    vid = int(f[4:-8])
+                except ValueError:
+                    continue
+                if vid < floor:
+                    os.remove(os.path.join(self._state_dir, f))
+            elif f.startswith("v") and f.endswith(".npz"):
                 try:
                     vid = int(f[1:-4])
                 except ValueError:
@@ -355,6 +576,9 @@ class StreamingQuery:
 
     def _run_batch(self, batch_id: int, start: int, end: int) -> None:
         table = self.stream.slice(start, end)
+        if self._event_time:
+            self._run_batch_event(batch_id, table)
+            return
         if self._agg is None:
             # stateless: swap the stream placeholder for this slice and
             # run the normal engine

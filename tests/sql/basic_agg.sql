@@ -1,0 +1,2 @@
+SELECT k, count(*) AS c, sum(v) AS s, min(v) AS mn, max(v) AS mx
+FROM golden_t GROUP BY k ORDER BY k

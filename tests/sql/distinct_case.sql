@@ -1,0 +1,2 @@
+SELECT k, count(DISTINCT s) AS ds
+FROM golden_t GROUP BY k ORDER BY k

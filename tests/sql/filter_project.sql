@@ -1,0 +1,1 @@
+SELECT k, v * 2 AS v2, s FROM golden_t WHERE v > 10 AND k <> 2 ORDER BY k, v2

@@ -1,0 +1,2 @@
+SELECT k, avg(v) AS av FROM golden_t GROUP BY k
+HAVING count(*) > (SELECT min(k) + 2 FROM golden_dim) ORDER BY k

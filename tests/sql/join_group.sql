@@ -1,0 +1,3 @@
+SELECT d.name, count(*) AS c, sum(g.v) AS sv
+FROM golden_t g JOIN golden_dim d ON g.k = d.k
+GROUP BY d.name ORDER BY d.name

@@ -1,0 +1,2 @@
+SELECT upper(s) AS u, length(s) AS l, v
+FROM golden_t WHERE v < 8 ORDER BY u, l, v

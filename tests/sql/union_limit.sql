@@ -1,0 +1,4 @@
+SELECT k, v FROM golden_t WHERE k = 0
+UNION ALL
+SELECT k, v FROM golden_t WHERE k = 1
+ORDER BY k, v LIMIT 7

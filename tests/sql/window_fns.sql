@@ -1,0 +1,4 @@
+SELECT k, v, row_number() OVER (PARTITION BY k ORDER BY v) AS rn,
+       sum(v) OVER (PARTITION BY k ORDER BY v
+                    ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS rsum
+FROM golden_t ORDER BY k, v

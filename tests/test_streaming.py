@@ -165,3 +165,97 @@ def test_checkpoint_pruning(session, tmp_path):
     assert len(states) <= 3, states
     out = q.latest()
     assert out["c"].sum() == 6
+
+
+# -- event time / watermarks (WatermarkTracker.scala:1) ---------------------
+
+def _ts(s):
+    return pd.Timestamp(s)
+
+
+def _event_df(session):
+    from spark_tpu.streaming import MemoryStream
+    schema = pd.DataFrame({"ts": [pd.Timestamp("2024-01-01")],
+                           "v": [0.0]})
+    stream = MemoryStream(session, schema)
+    df = (stream.to_df()
+          .with_watermark("ts", "10 seconds")
+          .group_by(F.window(col("ts"), "10 seconds").alias("w"))
+          .agg(F.sum(col("v")).alias("s"), F.count().alias("c")))
+    return stream, df
+
+
+def test_event_time_complete_out_of_order(session, tmp_path):
+    stream, df = _event_df(session)
+    q = df.write_stream(str(tmp_path / "ck"), output_mode="complete")
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:01"), _ts("2024-01-01 00:00:12")],
+        "v": [1.0, 2.0]}))
+    q.process_available()
+    # an out-of-order (but within-watermark) row lands in window 0
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:05")], "v": [4.0]}))
+    q.process_available()
+    out = q.latest().sort_values("w").reset_index(drop=True)
+    assert out["s"].tolist() == [5.0, 2.0]
+    assert out["c"].tolist() == [2, 1]
+
+
+def test_event_time_late_rows_dropped(session, tmp_path):
+    stream, df = _event_df(session)
+    q = df.write_stream(str(tmp_path / "ck"), output_mode="complete")
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:01:00")], "v": [1.0]}))
+    q.process_available()   # watermark -> 00:00:50
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:20"),   # older than wm: dropped
+               _ts("2024-01-01 00:00:55")],  # within wm: counted
+        "v": [100.0, 2.0]}))
+    q.process_available()
+    out = q.latest().sort_values("w").reset_index(drop=True)
+    assert out["s"].tolist() == [2.0, 1.0]
+
+
+def test_event_time_append_emits_closed_windows_once(session, tmp_path):
+    stream, df = _event_df(session)
+    q = df.write_stream(str(tmp_path / "ck"), output_mode="append")
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:01"), _ts("2024-01-01 00:00:03")],
+        "v": [1.0, 2.0]}))
+    q.process_available()   # wm = 3s-10s: nothing closed, nothing out
+    assert q.latest() is None or len(q.latest()) == 0 or \
+        len(q.results()) == 0
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:30")], "v": [8.0]}))
+    q.process_available()   # wm = 20s: window [0,10) closes and emits
+    emitted = pd.concat(q.results(), ignore_index=True)
+    assert len(emitted) == 1
+    assert emitted["s"].tolist() == [3.0]
+    assert emitted["w"][0] == _ts("2024-01-01 00:00:00")
+    # the closed window is evicted from state
+    assert (q._evstate["w"] != 0).all()
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:01:00")], "v": [16.0]}))
+    q.process_available()   # wm = 50s: window [30,40) closes
+    emitted = pd.concat(q.results(), ignore_index=True)
+    assert emitted["s"].tolist() == [3.0, 8.0]  # first window NOT re-emitted
+
+
+def test_event_time_recovery_restores_watermark_and_state(session,
+                                                          tmp_path):
+    from spark_tpu.streaming import MemoryStream
+    ck = str(tmp_path / "ck")
+    stream, df = _event_df(session)
+    q = df.write_stream(ck, output_mode="complete")
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:01")], "v": [1.0]}))
+    q.process_available()
+    wm1 = q._wm
+    # fresh query over the same checkpoint + the same source content
+    q2 = df.write_stream(ck, output_mode="complete")
+    assert q2._wm == wm1
+    stream.add_data(pd.DataFrame({
+        "ts": [_ts("2024-01-01 00:00:04")], "v": [2.0]}))
+    q2.process_available()
+    out = q2.latest()
+    assert out["s"].tolist() == [3.0]
