@@ -176,8 +176,10 @@ def bench_kernel_pick(spark):
 
 def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
                                                      "q5"),
-               float_atol: float = 1e-4):
-    """Generate (cached) SF data, run the queries timed, check parity."""
+               float_atol: float = 1e-4, deadline: float = None):
+    """Generate (cached) SF data, run the queries timed, check parity.
+    `deadline` (perf_counter value): remaining queries are skipped once
+    passed, so a slow scale factor can never starve the whole bench."""
     from spark_tpu.tpch import golden as G
     from spark_tpu.tpch import queries as Q
     from spark_tpu.tpch.datagen import write_parquet
@@ -186,6 +188,9 @@ def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
     Q.register_tables(spark, path)
     extra = {}
     for name in queries:
+        if deadline is not None and time.perf_counter() > deadline:
+            extra[f"tpch_{name}_sf{sf:g}_skipped"] = "time budget"
+            continue
         df_fn = Q.QUERIES[name]
 
         def run_once():
@@ -256,8 +261,11 @@ def main():
             os.path.abspath(__file__)), "data", "tpch", "sf10")
         try:
             spark.conf.set("spark_tpu.sql.io.deviceCacheBytes", 12 << 30)
-            extra.update(bench_tpch(spark, 10, sf10_path,
-                                    float_atol=1e-3))
+            budget_s = float(os.environ.get("BENCH_SF10_BUDGET_S",
+                                            "1500"))
+            extra.update(bench_tpch(
+                spark, 10, sf10_path, float_atol=1e-3,
+                deadline=time.perf_counter() + budget_s))
         except Exception as e:
             extra["tpch_sf10_error"] = f"{type(e).__name__}: {e}"[:300]
         finally:

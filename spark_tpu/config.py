@@ -141,6 +141,14 @@ STREAMING_CHUNK_ROWS = register(
         "the way the reference's row-iterator pipeline does. (1<<26 "
         "chunks faulted the v5e runtime on wide-domain aggregates.)")
 
+TASK_MAX_FAILURES = register(
+    "spark_tpu.sql.execution.maxTaskFailures", 2,
+    doc="Retries for TRANSIENT runtime/compile failures of a jitted "
+        "stage (e.g. a remote-compile 500 on tunneled runtimes) before "
+        "surfacing the error; compiled-stage caches are dropped so the "
+        "retry recompiles. The spark.task.maxFailures seat — gang SPMD "
+        "retries the whole stage, not one task.")
+
 SKEW_JOIN_ENABLED = register(
     "spark_tpu.sql.adaptive.skewJoin.enabled", True,
     doc="When a shuffle join's exchange overflows with one receive "

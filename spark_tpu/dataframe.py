@@ -165,6 +165,26 @@ class DataFrame:
 
     unionAll = union
 
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """INTERSECT (distinct): rows present in both sides, NULLs
+        matching NULLs (reference: basicLogicalOperators Intersect ->
+        ReplaceIntersectWithSemiJoin)."""
+        return self._with(set_op_plan(self.plan, other.plan,
+                                      "left_semi"))
+
+    def except_(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT (distinct): rows of this side absent from the other
+        (ReplaceExceptWithAntiJoin)."""
+        return self._with(set_op_plan(self.plan, other.plan,
+                                      "left_anti"))
+
+    subtract = except_
+
+    def exceptAll(self, other: "DataFrame") -> "DataFrame":
+        raise AnalysisError(
+            "EXCEPT ALL (multiset) is not supported; use except_ for "
+            "the DISTINCT form")
+
     def distinct(self) -> "DataFrame":
         """Deduplicate rows: an aggregate grouping on every column with no
         aggregate functions (reference: Dataset.distinct -> Deduplicate ->
@@ -398,6 +418,44 @@ class DataFrameStat:
         return CountMinSketch.build(data, eps, confidence, mask=mask)
 
     countMinSketch = count_min_sketch
+
+
+def set_op_plan(lp: L.LogicalPlan, rp: L.LogicalPlan,
+                how: str) -> L.LogicalPlan:
+    """INTERSECT/EXCEPT (distinct) as a tagged union + group-by: each
+    side contributes a presence flag, one aggregate groups on every
+    column (group keys are natively NULL-safe and support every dtype),
+    and a filter keeps groups present on the right side(s). Equivalent
+    to the reference's ReplaceIntersectWithSemiJoin /
+    ReplaceExceptWithAntiJoin rewrites, expressed in the aggregate
+    algebra the TPU engine is best at."""
+    from .expr import Literal
+    from .expr_agg import Max
+    ls, rs = lp.schema(), rp.schema()
+    if len(ls.fields) != len(rs.fields):
+        raise AnalysisError(
+            f"set operation needs equal column counts "
+            f"({len(ls.fields)} vs {len(rs.fields)})")
+    lnames = ls.names
+    tag_l = L.Project(lp, [ColumnRef(n) for n in lnames]
+                      + [Alias(Literal(1), "__in_l"),
+                         Alias(Literal(0), "__in_r")])
+    # right columns rename to the left's so the union lines up
+    tag_r = L.Project(rp, [Alias(ColumnRef(rn), ln)
+                           for rn, ln in zip(rs.names, lnames)]
+                      + [Alias(Literal(0), "__in_l"),
+                         Alias(Literal(1), "__in_r")])
+    u = L.Union(tag_l, tag_r)
+    g = L.Aggregate(u, [ColumnRef(n) for n in lnames],
+                    [AggExpr(Max(ColumnRef("__in_l")), "__lf"),
+                     AggExpr(Max(ColumnRef("__in_r")), "__rf")])
+    lf = ColumnRef("__lf")
+    rf = ColumnRef("__rf")
+    cond = (lf == Literal(1)) & (rf == Literal(1)) \
+        if how == "left_semi" else \
+        (lf == Literal(1)) & (rf == Literal(0))
+    return L.Project(L.Filter(g, cond),
+                     [ColumnRef(n) for n in lnames])
 
 
 class GroupedData:

@@ -322,9 +322,15 @@ def sort_aggregate(key_vecs: Sequence[Vec],
         (~sel).astype(jnp.int32)
     operands.append(invalid)
     for vec in key_vecs:
+        data = vec.data
         if vec.validity is not None:
             operands.append((~vec.validity).astype(jnp.int8))
-        operands.append(vec.data)
+            # neutralize data under NULL: two NULL keys must land in ONE
+            # group even when their dead payloads differ (e.g. after a
+            # union's dictionary remap)
+            data = jnp.where(vec.validity, data,
+                             jnp.zeros((), data.dtype))
+        operands.append(data)
     num_keys = len(operands)
     operands.append(jnp.arange(capacity, dtype=jnp.int32))  # permutation payload
     sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
@@ -422,9 +428,12 @@ def positional_sort(key_vecs: Sequence[Vec], value_vec: Vec, sel,
         (~sel).astype(jnp.int32)
     operands.append(invalid)
     for vec in key_vecs:
+        data = vec.data
         if vec.validity is not None:
             operands.append((~vec.validity).astype(jnp.int8))
-        operands.append(vec.data)
+            data = jnp.where(vec.validity, data,
+                             jnp.zeros((), data.dtype))
+        operands.append(data)
     vinvalid = jnp.zeros((capacity,), jnp.int8) \
         if value_vec.validity is None else \
         (~value_vec.validity).astype(jnp.int8)

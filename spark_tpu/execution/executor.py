@@ -254,13 +254,17 @@ class QueryExecution:
             return P.InputExec(b, node.schema(), label="generated")
         return node
 
-    def _compile_stage(self, root: P.PhysicalPlan, mesh=None):
+    def _stage_key(self, root: P.PhysicalPlan, mesh=None) -> str:
         conf = self.session.conf
         n = int(mesh.devices.size) if mesh is not None else 1
         metrics_on = bool(conf.get("spark_tpu.sql.metrics.enabled"))
-        key = (root.describe()
-               + (f"#mesh{n}" if mesh is not None else "")
-               + f"#m{int(metrics_on)}")
+        return (root.describe()
+                + (f"#mesh{n}" if mesh is not None else "")
+                + f"#m{int(metrics_on)}")
+
+    def _compile_stage(self, root: P.PhysicalPlan, mesh=None):
+        conf = self.session.conf
+        key = self._stage_key(root, mesh)
         fn = self.session._stage_cache.get(key)
         if fn is not None:
             return fn
@@ -489,13 +493,40 @@ class QueryExecution:
         import contextlib
         prof = jax.profiler.trace(profile_dir) if profile_dir else \
             contextlib.nullcontext()
+        max_fail = int(self.session.conf.get(
+            "spark_tpu.sql.execution.maxTaskFailures"))
+        transient_left = max(0, max_fail)
         with prof:
+            overflow: List[str] = []
             for _attempt in range(8):
-                fn = self._compile_stage(root, mesh)
-                if mesh is None:
-                    batch, flags, metrics = fn(scan_batches)
-                else:
-                    batch, flags, metrics = fn(scan_batches, token)
+                # transient infra failures (remote-compile 500s on
+                # tunneled runtimes, UNAVAILABLE) retry with a fresh
+                # compile in their OWN loop — the spark.task.maxFailures
+                # analog; they never consume capacity-replan iterations
+                while True:
+                    fn = self._compile_stage(root, mesh)
+                    try:
+                        if mesh is None:
+                            batch, flags, metrics = fn(scan_batches)
+                        else:
+                            batch, flags, metrics = fn(scan_batches,
+                                                       token)
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        msg = f"{type(e).__name__}: {e}"
+                        transient = any(t in msg for t in (
+                            "remote_compile", "UNAVAILABLE",
+                            "DEADLINE_EXCEEDED"))
+                        if not transient or transient_left <= 0:
+                            raise
+                        transient_left -= 1
+                        import warnings
+                        warnings.warn(
+                            f"transient stage failure, retrying "
+                            f"({transient_left} left): {msg[:160]}")
+                        # evict only THIS stage's compiled entry
+                        self.session._stage_cache.pop(
+                            self._stage_key(root, mesh), None)
                 # ONE batched host pull for the whole stats channel —
                 # per-scalar np.asarray costs an RPC round trip each on
                 # tunneled runtimes

@@ -278,8 +278,15 @@ class Literal(Expression):
 
     def eval_scalar(self) -> Vec:
         if self.value is None:
+            # NULL strings carry a placeholder dictionary so unions/ops
+            # see a well-formed dictionary column (validity is false
+            # everywhere, so the placeholder value never materializes;
+            # a 0-length dictionary would break code remapping)
+            dic = pa.array([""]) \
+                if isinstance(self._dtype, T.StringType) else None
             return Vec(jnp.zeros((), dtype=self._dtype.np_dtype), self._dtype,
-                       validity=jnp.zeros((), dtype=jnp.bool_))
+                       validity=jnp.zeros((), dtype=jnp.bool_),
+                       dictionary=dic)
         v = self.value
         if isinstance(self._dtype, T.DecimalType):
             import decimal
@@ -1335,6 +1342,11 @@ class Coalesce(Expression):
             if validity is None:
                 break
             v = c.eval(batch)
+            if v.data is None and isinstance(c, Literal) \
+                    and isinstance(c.value, str):
+                # host-scalar string literal -> singleton dictionary
+                v = Vec(jnp.zeros(np.shape(data), jnp.int32), T.STRING,
+                        None, pa.array([c.value]))
             if dictionary is None or v.dictionary is None:
                 raise AnalysisError("coalesce on strings requires dictionaries")
             data, v_data, dictionary = unify_string_columns(

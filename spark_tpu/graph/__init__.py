@@ -1,0 +1,13 @@
+"""spark_tpu.graph: the GraphX analog (reference:
+`graphx/src/main/scala/org/apache/spark/graphx/Graph.scala`,
+`Pregel.scala:59`), re-designed TPU-first: vertices and edges are
+device columns; one Pregel superstep = gather (edge-indexed takes) ->
+message combine (segment reduce) -> vertex program (elementwise) inside
+a single jitted `lax.while_loop`, replacing the reference's per-
+iteration RDD joins + shuffles.
+"""
+
+from .graph import Graph, pregel
+from .lib import connected_components, page_rank
+
+__all__ = ["Graph", "pregel", "page_rank", "connected_components"]

@@ -1,0 +1,32 @@
+"""spark_tpu.ml: the MLlib analog (reference: `mllib/src/main/scala/
+org/apache/spark/ml/Pipeline.scala:1` + feature/regression/
+classification/clustering packages), re-designed TPU-first:
+
+- feature vectors are fixed-width array columns (the engine's offsets
+  layout) that reshape to a dense [rows, n_features] device matrix —
+  every algorithm below is then MXU matmuls + jitted optimization
+  loops, not per-row iterators;
+- estimators `fit` on a DataFrame and return Models (Transformers);
+  `Pipeline` chains them exactly like the reference's Estimator/
+  Transformer/Params contract;
+- training is one `jax.jit` program per estimator (normal equations,
+  lax.scan gradient descent, Lloyd iterations) — the data-parallel
+  `treeAggregate` loops of the reference collapse into XLA reductions.
+"""
+
+from .base import Estimator, Model, Pipeline, PipelineModel, Transformer
+from .feature import StandardScaler, StandardScalerModel, VectorAssembler
+from .regression import LinearRegression, LinearRegressionModel
+from .classification import LogisticRegression, LogisticRegressionModel
+from .clustering import KMeans, KMeansModel
+from .evaluation import (BinaryClassificationEvaluator,
+                         RegressionEvaluator)
+
+__all__ = [
+    "Estimator", "Model", "Pipeline", "PipelineModel", "Transformer",
+    "VectorAssembler", "StandardScaler", "StandardScalerModel",
+    "LinearRegression", "LinearRegressionModel",
+    "LogisticRegression", "LogisticRegressionModel",
+    "KMeans", "KMeansModel",
+    "RegressionEvaluator", "BinaryClassificationEvaluator",
+]

@@ -1,0 +1,83 @@
+"""KMeans (reference: ml/clustering/KMeans.scala): Lloyd's iterations
+as one jitted lax.scan — the [n, k] distance matrix is an MXU matmul
+(|x|^2 - 2 x.c + |c|^2) and centroid updates are segment sums, versus
+the reference's per-partition runs + collectAsMap per iteration."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Estimator, Model
+from .util import attach_column, collect_xy
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _lloyd(X, init_centers, k: int, max_iter: int):
+    n = X.shape[0]
+    x2 = jnp.sum(X * X, axis=1, keepdims=True)        # [n, 1]
+
+    def assign(C):
+        c2 = jnp.sum(C * C, axis=1)[None, :]          # [1, k]
+        d = x2 - 2.0 * (X @ C.T) + c2                 # MXU
+        return jnp.argmin(d, axis=1)
+
+    def body(C, _):
+        a = assign(C)
+        one = jnp.ones((n,), X.dtype)
+        cnt = jax.ops.segment_sum(one, a, num_segments=k)
+        tot = jax.ops.segment_sum(X, a, num_segments=k)
+        newC = tot / jnp.maximum(cnt, 1.0)[:, None]
+        # empty clusters keep their previous center
+        newC = jnp.where((cnt > 0)[:, None], newC, C)
+        return newC, None
+
+    C, _ = jax.lax.scan(body, init_centers, None, length=max_iter)
+    return C, assign(C)
+
+
+class KMeans(Estimator):
+    def __init__(self, k=2, featuresCol="features",
+                 predictionCol="prediction", maxIter=20, seed=42):
+        self.k = int(k)
+        self.featuresCol = featuresCol
+        self.predictionCol = predictionCol
+        self.maxIter = int(maxIter)
+        self.seed = int(seed)
+
+    def fit(self, df) -> "KMeansModel":
+        _, X, _ = collect_xy(df, self.featuresCol, None)
+        rs = np.random.RandomState(self.seed)
+        # farthest-point init (the k-means|| seat): robust to seeds
+        # landing inside one cluster, deterministic per seed
+        centers = [X[rs.randint(len(X))]]
+        for _ in range(1, self.k):
+            d = np.min(np.stack([
+                np.sum((X - c) ** 2, axis=1) for c in centers]), axis=0)
+            centers.append(X[int(np.argmax(d))])
+        init = np.stack(centers)
+        C, _ = _lloyd(jnp.asarray(X), jnp.asarray(init), self.k,
+                      self.maxIter)
+        return KMeansModel(self.featuresCol, self.predictionCol,
+                           np.asarray(C))
+
+
+class KMeansModel(Model):
+    def __init__(self, featuresCol, predictionCol, centers):
+        self.featuresCol = featuresCol
+        self.predictionCol = predictionCol
+        self.cluster_centers = np.asarray(centers)
+
+    clusterCenters = property(lambda self: self.cluster_centers)
+
+    def transform(self, df):
+        table, X, _ = collect_xy(df, self.featuresCol, None)
+        C = jnp.asarray(self.cluster_centers)
+        Xj = jnp.asarray(X)
+        d = (jnp.sum(Xj * Xj, axis=1, keepdims=True)
+             - 2.0 * (Xj @ C.T) + jnp.sum(C * C, axis=1)[None, :])
+        a = np.asarray(jnp.argmin(d, axis=1)).astype(np.int64)
+        return attach_column(df, table, self.predictionCol, a)

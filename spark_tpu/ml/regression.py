@@ -1,0 +1,80 @@
+"""Linear regression (reference: ml/regression/LinearRegression.scala).
+
+TPU-first: the training pass is ONE jitted program — the Gram matrix
+X^T X and moment vector X^T y are MXU matmuls, the solve is a tiny
+[d+1, d+1] linear system — instead of the reference's treeAggregate of
+per-partition gradient summaries (WeightedLeastSquares.scala)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Estimator, Model
+from .util import attach_column, collect_xy
+
+
+@jax.jit
+def _gram_moments(X, y, reg):
+    """Device side: the O(n d^2) matmuls. The tiny [d+1, d+1] solve
+    happens on host — TPU XLA implements LuDecomposition only for f32,
+    and the Gram matrix is a few KB anyway."""
+    n = X.shape[0]
+    Xb = jnp.concatenate([X, jnp.ones((n, 1), X.dtype)], axis=1)
+    gram = Xb.T @ Xb                    # MXU
+    gram = gram + reg * jnp.eye(Xb.shape[1], dtype=X.dtype) \
+        .at[-1, -1].set(0.0)            # no intercept regularization
+    return gram, Xb.T @ y
+
+
+def _normal_solve(X, y, reg):
+    gram, xty = _gram_moments(X, y, reg)
+    return np.linalg.solve(np.asarray(gram), np.asarray(xty))
+
+
+class LinearRegression(Estimator):
+    def __init__(self, featuresCol="features", labelCol="label",
+                 predictionCol="prediction", regParam=0.0):
+        self.featuresCol = featuresCol
+        self.labelCol = labelCol
+        self.predictionCol = predictionCol
+        self.regParam = float(regParam)
+
+    def fit(self, df) -> "LinearRegressionModel":
+        _, X, y = collect_xy(df, self.featuresCol, self.labelCol)
+        theta = np.asarray(_normal_solve(jnp.asarray(X), jnp.asarray(y),
+                                         jnp.float64(self.regParam)))
+        return LinearRegressionModel(self.featuresCol,
+                                     self.predictionCol,
+                                     theta[:-1], float(theta[-1]))
+
+
+class LinearRegressionModel(Model):
+    def __init__(self, featuresCol, predictionCol, coefficients,
+                 intercept):
+        self.featuresCol = featuresCol
+        self.predictionCol = predictionCol
+        self.coefficients = np.asarray(coefficients)
+        self.intercept = float(intercept)
+
+    def transform(self, df):
+        table, X, _ = collect_xy(df, self.featuresCol, None)
+        pred = np.asarray(
+            jnp.asarray(X) @ jnp.asarray(self.coefficients)
+            + self.intercept)
+        return attach_column(df, table, self.predictionCol, pred)
+
+    def save(self, path: str) -> None:
+        np.savez(path, coefficients=self.coefficients,
+                 intercept=self.intercept,
+                 featuresCol=self.featuresCol,
+                 predictionCol=self.predictionCol)
+
+    @staticmethod
+    def load(path: str) -> "LinearRegressionModel":
+        z = np.load(path, allow_pickle=True)
+        return LinearRegressionModel(str(z["featuresCol"]),
+                                     str(z["predictionCol"]),
+                                     z["coefficients"],
+                                     float(z["intercept"]))

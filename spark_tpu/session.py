@@ -167,7 +167,41 @@ class SparkTpuSession:
         from .io.sources import JsonSource
         return DataFrame(self, L.Scan(JsonSource(path, name)))
 
+    def long_accumulator(self, name: str = "acc") -> "Accumulator":
+        return Accumulator(name, 0)
+
+    def double_accumulator(self, name: str = "acc") -> "Accumulator":
+        return Accumulator(name, 0.0)
+
+    longAccumulator = long_accumulator
+    doubleAccumulator = double_accumulator
+
     def sql(self, query: str) -> DataFrame:
         from .sql.parser import parse_sql
         plan = parse_sql(query, self)
         return DataFrame(self, plan)
+
+
+class Accumulator:
+    """Driver-side mergeable counter (reference: AccumulatorV2.scala:44).
+    Python UDFs and grouped-map functions run host-side, so updates are
+    plain in-process adds — the task->driver merge protocol collapses
+    away; per-operator engine metrics ride the psum'd stats channel
+    instead (metric/SQLMetrics.scala:40 analog in ExecContext)."""
+
+    def __init__(self, name: str, value=0):
+        self.name = name
+        self._value = value
+
+    def add(self, v) -> None:
+        self._value += v
+
+    @property
+    def value(self):
+        return self._value
+
+    def reset(self) -> None:
+        self._value = type(self._value)()
+
+    def __repr__(self):
+        return f"Accumulator({self.name}={self._value!r})"

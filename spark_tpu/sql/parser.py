@@ -44,7 +44,7 @@ _KEYWORDS = {
     "CASE", "WHEN", "THEN", "ELSE", "END", "CAST", "JOIN", "INNER",
     "LEFT", "RIGHT", "FULL", "OUTER", "CROSS", "SEMI", "ANTI", "ON",
     "ASC", "DESC", "UNION", "ALL", "DISTINCT", "DATE", "INTERVAL",
-    "EXTRACT", "TRUE", "FALSE", "EXISTS", "WITH",
+    "EXTRACT", "TRUE", "FALSE", "EXISTS", "WITH", "INTERSECT", "EXCEPT",
 }
 
 
@@ -170,20 +170,36 @@ class Parser:
         """select [UNION ALL select]... — the query-expression body used
         at top level AND inside CTE bodies/subqueries, so set operations
         work in every position."""
-        sel = self.parse_select()
-        while self.at_kw("UNION"):
-            self.next()
-            if not self.eat_kw("ALL"):
-                raise ParseError("only UNION ALL is supported (UNION "
-                                 "DISTINCT needs dropDuplicates)")
-            right = self.parse_select()
-            # a trailing ORDER BY / LIMIT binds to the WHOLE union, not
-            # the right arm (standard SQL set-operation precedence)
-            union = _Select(union_of=(sel, right),
-                            order_by=right.order_by, limit=right.limit)
+        def combine(left, right, kind):
+            # a trailing ORDER BY / LIMIT binds to the WHOLE set
+            # operation, not the right arm (standard SQL precedence)
+            out = _Select(union_of=(left, right), set_op=kind,
+                          order_by=right.order_by, limit=right.limit)
             right.order_by = None
             right.limit = None
-            sel = union
+            return out
+
+        def intersect_term():
+            # INTERSECT binds tighter than UNION/EXCEPT (standard SQL)
+            t = self.parse_select()
+            while self.at_kw("INTERSECT"):
+                self.next()
+                self.eat_kw("DISTINCT")
+                t = combine(t, self.parse_select(), "intersect")
+            return t
+
+        sel = intersect_term()
+        while self.at_kw("UNION", "EXCEPT"):
+            op = self.next().upper
+            if op == "UNION":
+                if not self.eat_kw("ALL"):
+                    raise ParseError("only UNION ALL is supported (UNION "
+                                     "DISTINCT needs dropDuplicates)")
+                kind = "union_all"
+            else:
+                self.eat_kw("DISTINCT")  # the default for set ops
+                kind = "except"
+            sel = combine(sel, intersect_term(), kind)
         return sel
 
     def parse_select(self) -> "_Select":
@@ -219,9 +235,13 @@ class Parser:
         if self.at_kw("GROUP"):
             self.next()
             self.expect_kw("BY")
-            sel.group_by = [self.parse_expr()]
-            while self.eat_op(","):
-                sel.group_by.append(self.parse_expr())
+            if self.at_kw("ROLLUP", "CUBE", "GROUPING"):
+                sel.group_by, sel.grouping_sets = \
+                    self._parse_grouping_analytics()
+            else:
+                sel.group_by = [self.parse_expr()]
+                while self.eat_op(","):
+                    sel.group_by.append(self.parse_expr())
         if self.eat_kw("HAVING"):
             sel.having = self.parse_expr()
         if self.at_kw("ORDER"):
@@ -236,6 +256,54 @@ class Parser:
                 raise ParseError(f"LIMIT expects a number at {t.pos}")
             sel.limit = int(t.value)
         return sel
+
+    def _parse_grouping_analytics(self):
+        """ROLLUP(a, b) / CUBE(a, b) / GROUPING SETS((a, b), (a), ())
+        -> (full column list, list of name subsets). Reference:
+        SqlBase.g4 groupingAnalytics -> Expand planning; here each set
+        lowers to its own aggregate union-ed together (ExpandExec.scala
+        semantics without the row-expansion operator)."""
+        kind = self.next().upper
+        cols: List[str] = []
+        sets: List[List[str]] = []
+
+        def ident_list():
+            names = []
+            self.expect_op("(")
+            if not self.at_op(")"):
+                names.append(self._ident())
+                while self.eat_op(","):
+                    names.append(self._ident())
+            self.expect_op(")")
+            return names
+
+        if kind in ("ROLLUP", "CUBE"):
+            cols = ident_list()
+            if kind == "ROLLUP":
+                sets = [cols[:i] for i in range(len(cols), -1, -1)]
+            else:
+                import itertools
+                sets = [list(c) for r in range(len(cols), -1, -1)
+                        for c in itertools.combinations(cols, r)]
+        else:
+            self.expect_kw("SETS")
+            self.expect_op("(")
+            while True:
+                if self.at_op("("):
+                    sets.append(ident_list())
+                else:
+                    # bare column = a single-column grouping set
+                    sets.append([self._ident()])
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
+            seen = []
+            for s_ in sets:
+                for n in s_:
+                    if n not in seen:
+                        seen.append(n)
+            cols = seen
+        return [ColumnRef(n) for n in cols], sets
 
     def _ident(self) -> str:
         t = self.next()
@@ -954,6 +1022,8 @@ class _Select:
     order_by: Optional[List[Tuple[Expression, bool, Optional[bool]]]] = None
     limit: Optional[int] = None
     union_of: Optional[Tuple["_Select", "_Select"]] = None
+    set_op: str = "union_all"  # union_all | intersect | except
+    grouping_sets: Optional[List[List[str]]] = None  # ROLLUP/CUBE/SETS
     ctes: Optional[List] = None  # (name, col_aliases, _Select) triples
 
 
@@ -1109,9 +1179,18 @@ class Lowerer:
             # implicit=True scopes the entry to one statement execution
             # (evicted afterwards — no staleness, no unbounded growth)
             self.session.mark_cache(plan, implicit=True)
+        if getattr(sel, "grouping_sets", None):
+            return self._lower_grouping_sets(sel)
         if sel.union_of is not None:
-            plan = L.Union(self.lower(sel.union_of[0]),
-                           self.lower(sel.union_of[1]))
+            lplan = self.lower(sel.union_of[0])
+            rplan = self.lower(sel.union_of[1])
+            if sel.set_op == "union_all":
+                plan = L.Union(lplan, rplan)
+            else:
+                from ..dataframe import set_op_plan
+                plan = set_op_plan(lplan, rplan,
+                                   "left_semi" if sel.set_op ==
+                                   "intersect" else "left_anti")
             plan = self._lower_order_limit(sel, plan)
             if sel.limit is not None:
                 plan = L.Limit(plan, sel.limit)
@@ -1708,6 +1787,81 @@ class Lowerer:
 
         cond = scope.rewrite(rewrite(c))
         return L.Filter(plan, cond)
+
+    def _lower_grouping_sets(self, sel: _Select) -> L.LogicalPlan:
+        """ROLLUP/CUBE/GROUPING SETS: one aggregate per grouping set,
+        missing keys re-projected as typed NULLs, UNION ALL of the lot
+        (the reference's Expand + single-aggregate plan produces the
+        same relation — `ExpandExec.scala:1`; the union form trades one
+        wide scan for set-count scans but keeps every aggregate on the
+        fast grouped path)."""
+        import copy as _c
+        group_names = [g.name() for g in sel.group_by]
+        if sel.items is None:
+            raise AnalysisError(
+                "grouping analytics need an explicit select list")
+        out_names = []
+        for e, a in sel.items:
+            if a:
+                out_names.append(a)
+            elif isinstance(e, _QualifiedRef):
+                out_names.append(e.col)  # t1.a projects as "a"
+            else:
+                out_names.append(e.name() if hasattr(e, "name")
+                                 else repr(e))
+        # input schema for typed NULL placeholders
+        probe = _c.copy(sel)
+        probe.ctes = None
+        from_plan, _, _ = self._lower_from(probe)
+        from_schema = from_plan.schema()
+
+        plans = []
+        for gset in sel.grouping_sets:
+            sub = _c.copy(sel)
+            sub.grouping_sets = None
+            sub.ctes = None
+            sub.order_by = None
+            sub.limit = None
+            sub.group_by = [ColumnRef(n) for n in gset] or None
+            kept = []   # (expr, alias) | ("__null__", source_col_name)
+            gset_bare = {n.split(".")[-1].lower() for n in gset}
+            for e, a in sel.items:
+                # match plain AND table-qualified refs on the bare name
+                if isinstance(e, ColumnRef):
+                    bare = e._name.split(".")[-1].lower()
+                elif isinstance(e, _QualifiedRef):
+                    bare = e.col.lower()
+                else:
+                    bare = None
+                hit = bare is not None and any(
+                    g.split(".")[-1].lower() == bare
+                    for g in group_names)
+                if hit and bare not in gset_bare:
+                    kept.append(("__null__", bare))
+                else:
+                    kept.append((e, a))
+            sub.items = [k for k in kept
+                         if not isinstance(k[0], str)]
+            p = self.lower(sub)
+            sub_names = p.schema().names
+            exprs = []
+            pos = 0
+            for k, out_name in zip(kept, out_names):
+                if isinstance(k[0], str):  # "__null__" marker
+                    dt = ColumnRef(k[1]).dtype(from_schema)
+                    exprs.append(Alias(Literal(None, dt), out_name))
+                else:
+                    exprs.append(Alias(ColumnRef(sub_names[pos]),
+                                       out_name))
+                    pos += 1
+            plans.append(L.Project(p, exprs))
+        plan = plans[0]
+        for q in plans[1:]:
+            plan = L.Union(plan, q)
+        plan = self._lower_order_limit(sel, plan)
+        if sel.limit is not None:
+            plan = L.Limit(plan, sel.limit)
+        return plan
 
     def _extract_window_items(self, plan: L.LogicalPlan, items):
         """Pull WindowExpr nodes into Window plan nodes below the

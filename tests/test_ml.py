@@ -1,0 +1,113 @@
+"""spark_tpu.ml: Pipeline/Estimator/Transformer + linear & logistic
+regression, KMeans, scaler, evaluators (reference: ml/Pipeline.scala:1
+and friends), with closed-form numpy parity checks."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu.ml import (BinaryClassificationEvaluator, KMeans,
+                          LinearRegression, LinearRegressionModel,
+                          LogisticRegression, Pipeline,
+                          RegressionEvaluator, StandardScaler,
+                          VectorAssembler)
+
+
+@pytest.fixture
+def reg_df(session):
+    rs = np.random.RandomState(7)
+    n = 400
+    x1 = rs.randn(n)
+    x2 = rs.randn(n) * 2.0
+    y = 3.0 * x1 - 1.5 * x2 + 0.75 + rs.randn(n) * 0.01
+    pdf = pd.DataFrame({"x1": x1, "x2": x2, "label": y})
+    session.register_table("ml_reg", pdf)
+    return session.table("ml_reg"), pdf
+
+
+def test_linear_regression_parity_with_lstsq(reg_df):
+    df, pdf = reg_df
+    assembled = VectorAssembler(["x1", "x2"], "features").transform(df)
+    model = LinearRegression().fit(assembled)
+    A = np.column_stack([pdf[["x1", "x2"]].to_numpy(),
+                         np.ones(len(pdf))])
+    want, *_ = np.linalg.lstsq(A, pdf["label"].to_numpy(), rcond=None)
+    assert np.allclose(model.coefficients, want[:2], atol=1e-8)
+    assert np.isclose(model.intercept, want[2], atol=1e-8)
+    scored = model.transform(assembled)
+    rmse = RegressionEvaluator().evaluate(scored)
+    assert rmse < 0.02
+    r2 = RegressionEvaluator(metricName="r2").evaluate(scored)
+    assert r2 > 0.999
+
+
+def test_pipeline_fit_transform(reg_df):
+    df, _ = reg_df
+    pipe = Pipeline([
+        VectorAssembler(["x1", "x2"], "raw"),
+        StandardScaler(inputCol="raw", outputCol="features"),
+        LinearRegression(),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df)
+    rmse = RegressionEvaluator().evaluate(out)
+    assert rmse < 0.02
+
+
+def test_model_save_load(reg_df, tmp_path):
+    df, _ = reg_df
+    assembled = VectorAssembler(["x1", "x2"], "features").transform(df)
+    model = LinearRegression().fit(assembled)
+    p = str(tmp_path / "lr.npz")
+    model.save(p)
+    loaded = LinearRegressionModel.load(p)
+    assert np.allclose(loaded.coefficients, model.coefficients)
+    assert loaded.intercept == model.intercept
+
+
+def test_logistic_regression_separates(session):
+    rs = np.random.RandomState(11)
+    n = 600
+    x = rs.randn(n, 2)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    pdf = pd.DataFrame({"a": x[:, 0], "b": x[:, 1], "label": y})
+    session.register_table("ml_clf", pdf)
+    df = VectorAssembler(["a", "b"], "features").transform(
+        session.table("ml_clf"))
+    model = LogisticRegression(maxIter=300, stepSize=2.0).fit(df)
+    scored = model.transform(df)
+    out = scored.to_pandas()
+    acc = (out["prediction"] == out["label"]).mean()
+    assert acc > 0.97
+    auc = BinaryClassificationEvaluator().evaluate(scored)
+    assert auc > 0.99
+
+
+def test_kmeans_recovers_blobs(session):
+    rs = np.random.RandomState(5)
+    c1 = rs.randn(100, 2) * 0.2 + np.array([5.0, 5.0])
+    c2 = rs.randn(100, 2) * 0.2 + np.array([-5.0, 5.0])
+    c3 = rs.randn(100, 2) * 0.2 + np.array([0.0, -5.0])
+    X = np.vstack([c1, c2, c3])
+    pdf = pd.DataFrame({"a": X[:, 0], "b": X[:, 1],
+                        "blob": np.repeat([0, 1, 2], 100)})
+    session.register_table("ml_km", pdf)
+    df = VectorAssembler(["a", "b"], "features").transform(
+        session.table("ml_km"))
+    model = KMeans(k=3, maxIter=25, seed=3).fit(df)
+    out = model.transform(df).to_pandas()
+    # every true blob maps to exactly one predicted cluster
+    for b in range(3):
+        preds = out[out["blob"] == b]["prediction"]
+        assert preds.nunique() == 1
+    assert out["prediction"].nunique() == 3
+    centers = np.sort(np.round(model.cluster_centers), axis=0)
+    assert centers.shape == (3, 2)
+
+
+def test_params_set_and_errors():
+    lr = LinearRegression()
+    lr2 = lr.set(regParam=0.5)
+    assert lr2.regParam == 0.5 and lr.regParam == 0.0
+    with pytest.raises(ValueError):
+        lr.set(nope=1)
