@@ -15,11 +15,18 @@ and the TPC-H north-star queries (Q1/Q6/Q3/Q5) with result parity
 against the independent pandas goldens, per-query wall-clock in `extra`
 (the `TPCDSQueryBenchmark.scala:54` pattern).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+Output is timeout-proof (round-5 ran into the driver's rc:124 with zero
+parseable output): every section prints its OWN complete JSON line the
+moment it finishes (flushed), and each section runs under a SIGALRM
+deadline, so a killed or hung run still leaves one parseable line per
+completed section. The final line keeps the legacy aggregate shape:
+{"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
+import contextlib
 import json
 import os
+import signal
 import time
 
 import numpy as np
@@ -36,6 +43,65 @@ N_100G = 1_000_000_000
 TPCH_SF = float(os.environ.get("BENCH_TPCH_SF", "1"))
 TPCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "data", "tpch", f"sf{TPCH_SF:g}")
+
+
+class SectionTimeout(BaseException):
+    """BaseException, NOT Exception: section bodies (stddev fallbacks,
+    kernel_pick per-mode loop) catch broad Exception for infra
+    failures, and the deadline must punch through those handlers."""
+
+
+@contextlib.contextmanager
+def _section_deadline(seconds: float):
+    """SIGALRM-backed per-section bound. A section that blows its budget
+    raises SectionTimeout at the next Python bytecode (a single hung C
+    call can still stall past it, but the per-section JSON lines already
+    printed survive any outer `timeout` kill)."""
+    if seconds <= 0 or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def handler(signum, frame):
+        raise SectionTimeout()
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _emit(section: str, status: str, t0: float, data: dict) -> None:
+    print(json.dumps({"section": section, "status": status,
+                      "elapsed_s": round(time.perf_counter() - t0, 1),
+                      "data": data}), flush=True)
+
+
+def _run_section(name: str, fn, budget_s: float) -> dict:
+    """Run one bench section under its own deadline and emit its JSON
+    line immediately; always returns a dict (possibly {'error': ...})."""
+    t0 = time.perf_counter()
+    data = None
+    try:
+        with _section_deadline(budget_s):
+            data = fn()
+        _emit(name, "ok", t0, data)
+        return data
+    except SectionTimeout:
+        if data is not None:
+            # the alarm fired in the window between fn() returning and
+            # the deadline context disarming it: the section DID finish
+            _emit(name, "ok", t0, data)
+            return data
+        data = {f"{name}_error": f"section timeout after {budget_s:g}s"}
+        _emit(name, "timeout", t0, data)
+        return data
+    except Exception as e:  # noqa: BLE001
+        data = {f"{name}_error": f"{type(e).__name__}: {e}"[:300]}
+        _emit(name, "error", t0, data)
+        return data
 
 
 def _time3(run_sync):
@@ -212,6 +278,15 @@ def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
             if phase in qe.phase_times:
                 extra[f"tpch_{name}_{phase}_ms"] = round(
                     qe.phase_times[phase] * 1e3, 1)
+        # runtime-filter observability: fraction of probe rows the
+        # injected Bloom/min-max filters pruned before the exchanges
+        tested = sum(v for k, v in qe.last_metrics.items()
+                     if k.startswith("rtf_tested_"))
+        pruned = sum(v for k, v in qe.last_metrics.items()
+                     if k.startswith("rtf_pruned_"))
+        if tested:
+            extra[f"tpch_{name}_sf{sf:g}_rtf_pruned_ratio"] = round(
+                pruned / tested, 4)
         # result parity vs the independent pandas implementation
         for c in got.columns:
             if len(got) and got[c].dtype == object and \
@@ -231,53 +306,68 @@ def main():
     from spark_tpu import SparkTpuSession
 
     spark = SparkTpuSession.builder().get_or_create()
-    keys_rps = bench_linear_keys(spark)
+    budget = float(os.environ.get("BENCH_SECTION_BUDGET_S", "900"))
+
+    keys = _run_section(
+        "linear_keys",
+        lambda: {"keys_rows_per_sec_M":
+                 round(bench_linear_keys(spark) / 1e6, 1)},
+        budget)
+    keys_rps = keys.get("keys_rows_per_sec_M")
 
     extra = {}
-    try:
-        extra["stddev_rows_per_sec_M"] = round(bench_stddev(spark) / 1e6, 1)
-        extra["stddev_vs_baseline"] = round(
-            extra["stddev_rows_per_sec_M"] * 1e6 / STDDEV_BASELINE, 3)
-    except Exception as e:
-        extra["stddev_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
-        extra["grouped100_rows_per_sec_M"] = round(
-            bench_100_groups(spark) / 1e6, 1)
-    except Exception as e:
-        extra["grouped100_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
-        extra.update(bench_kernel_pick(spark))
-    except Exception as e:
-        extra["kern_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
-        extra.update(bench_tpch(spark, TPCH_SF, TPCH_PATH))
-    except Exception as e:  # keep the headline metric on TPC-H failure
-        extra["tpch_error"] = f"{type(e).__name__}: {e}"[:300]
+    if keys_rps is None:
+        extra.update(keys)  # surface the headline failure in the summary
+
+    def stddev_section():
+        rps = bench_stddev(spark)
+        return {"stddev_rows_per_sec_M": round(rps / 1e6, 1),
+                "stddev_vs_baseline": round(rps / STDDEV_BASELINE, 3)}
+
+    extra.update(_run_section("stddev", stddev_section, budget))
+    extra.update(_run_section(
+        "grouped100",
+        lambda: {"grouped100_rows_per_sec_M":
+                 round(bench_100_groups(spark) / 1e6, 1)},
+        budget))
+    extra.update(_run_section(
+        "kernel_pick", lambda: bench_kernel_pick(spark), budget))
+    extra.update(_run_section(
+        f"tpch_sf{TPCH_SF:g}",
+        lambda: bench_tpch(
+            spark, TPCH_SF, TPCH_PATH,
+            deadline=time.perf_counter() + budget * 0.9),
+        budget))
+
     # SF10: the north-star scale on one chip (VERDICT r4 #2). The
     # device-table cache budget rises so the pruned lineitem goes
     # RESIDENT (~3.6GB in 16GB HBM): warm runs then skip host ingest.
     if not os.environ.get("BENCH_SKIP_SF10"):
         sf10_path = os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "data", "tpch", "sf10")
-        try:
+        sf10_budget = float(os.environ.get("BENCH_SF10_BUDGET_S", "1500"))
+
+        def sf10_section():
             spark.conf.set("spark_tpu.sql.io.deviceCacheBytes", 12 << 30)
-            budget_s = float(os.environ.get("BENCH_SF10_BUDGET_S",
-                                            "1500"))
-            extra.update(bench_tpch(
-                spark, 10, sf10_path, float_atol=1e-3,
-                deadline=time.perf_counter() + budget_s))
-        except Exception as e:
-            extra["tpch_sf10_error"] = f"{type(e).__name__}: {e}"[:300]
-        finally:
-            spark.conf.set("spark_tpu.sql.io.deviceCacheBytes", 6 << 30)
+            try:
+                return bench_tpch(
+                    spark, 10, sf10_path, float_atol=1e-3,
+                    deadline=time.perf_counter() + sf10_budget)
+            finally:
+                spark.conf.set("spark_tpu.sql.io.deviceCacheBytes",
+                               6 << 30)
+
+        extra.update(_run_section("tpch_sf10", sf10_section,
+                                  sf10_budget * 1.1))
 
     print(json.dumps({
         "metric": "linear_keys_agg_rows_per_sec",
-        "value": round(keys_rps / 1e6, 1),
+        "value": keys_rps,
         "unit": "M rows/s",
-        "vs_baseline": round(keys_rps / KEYS_BASELINE, 3),
+        "vs_baseline": (round(keys_rps * 1e6 / KEYS_BASELINE, 3)
+                        if keys_rps is not None else None),
         "extra": extra,
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Preflight gate: run before committing/snapshotting so the round-5
+# class of "snapshot committed with a broken mesh path" cannot recur.
+#
+# Three stages, all mandatory:
+#   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
+#   2. dryrun_multichip(8): jit + run the distributed collectives path
+#      end-to-end with single-chip parity checks
+#   3. bench smoke: the headline aggregate shape at a reduced size, so
+#      the bench entrypoint itself (imports, section harness, JSON
+#      emission) is known-runnable before the driver spends a TPU slot
+#
+# Usage: scripts/preflight.sh [--fast]
+#   --fast skips the full pytest suite (stages 2+3 only) for quick
+#   inner-loop checks; CI and end-of-round runs must use the default.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+echo "== preflight: $(date -u +%FT%TZ) =="
+
+if [ "$FAST" -eq 0 ]; then
+    echo "-- stage 1/3: tier-1 test suite --"
+    rm -f /tmp/_preflight_t1.log
+    set +e  # keep control on pytest failure so the diagnostic prints
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee /tmp/_preflight_t1.log
+    rc=${PIPESTATUS[0]}
+    set -e
+    if [ "$rc" -ne 0 ]; then
+        echo "preflight FAILED: tier-1 suite rc=$rc" >&2
+        exit "$rc"
+    fi
+else
+    echo "-- stage 1/3: SKIPPED (--fast) --"
+fi
+
+echo "-- stage 2/3: dryrun_multichip(8) --"
+env JAX_PLATFORMS=cpu python -c "
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+"
+
+echo "-- stage 3/3: bench smoke --"
+# Reduced-size smoke of the bench entrypoint: section harness, JSON
+# emission and the aggregate hot path must run end-to-end on CPU.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import bench
+from spark_tpu import SparkTpuSession
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+
+spark = SparkTpuSession.builder().get_or_create()
+
+
+def smoke():
+    df = (spark.range(1 << 16)
+          .select(F.pmod(col("id"), 256).alias("k"))
+          .group_by(col("k")).agg(F.sum(col("k")).alias("s")))
+    pdf = df.to_pandas()
+    assert len(pdf) == 256, len(pdf)
+    return {"groups": int(len(pdf))}
+
+
+out = bench._run_section("bench_smoke", smoke, 300)
+assert out.get("groups") == 256, out
+print(json.dumps({"preflight_bench_smoke": "ok"}))
+EOF
+
+echo "== preflight PASSED =="

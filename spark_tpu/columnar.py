@@ -395,6 +395,13 @@ def _arrow_to_column(name: str, col: pa.ChunkedArray, n: int, cap: int) -> Colum
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return _arrow_list_to_column(name, arr, n, cap)
     dictionary = None
+    if pa.types.is_null(at):
+        # an empty/all-None pandas object column infers arrow `null`
+        # (e.g. a streaming schema df with pd.Series([], dtype=str)):
+        # treat it as an all-NULL string column, the dtype the object
+        # column would carry with any value present
+        arr = arr.cast(pa.string())
+        at = arr.type
     if pa.types.is_string(at) or pa.types.is_large_string(at):
         arr = arr.dictionary_encode()
         at = arr.type

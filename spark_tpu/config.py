@@ -197,6 +197,32 @@ DEVICE_CACHE_BYTES = register(
         "storage-memory-pool analog of UnifiedMemoryManager.scala:49 + "
         "CacheManager.scala.")
 
+RUNTIME_FILTER_ENABLED = register(
+    "spark_tpu.sql.runtimeFilter.enabled", True,
+    doc="Inject runtime join filters: when a join's build side is "
+        "selective, build a device Bloom filter (+ min/max key bounds "
+        "for ordered keys) from the build-side join keys in-stage and "
+        "prune probe rows BELOW the probe-side exchange, so pruned rows "
+        "never cross ICI. The InjectRuntimeFilter.scala:1 / "
+        "spark.sql.optimizer.runtime.bloomFilter.enabled analog. "
+        "Results are identical on/off; only row movement changes.")
+
+RUNTIME_FILTER_CREATION_THRESHOLD = register(
+    "spark_tpu.sql.runtimeFilter.creationSideThreshold", 256 << 20,
+    doc="Max estimated creation-side bytes (rows x 8 x columns, "
+        "pre-filter upper bound) for building a runtime filter; larger "
+        "build sides skip injection — re-computing the creation chain "
+        "plus the Bloom build must stay cheap relative to the probe "
+        "exchange it prunes. The bloomFilter.creationSideThreshold "
+        "analog.")
+
+RUNTIME_FILTER_FPP = register(
+    "spark_tpu.sql.runtimeFilter.expectedFpp", 0.03,
+    doc="Expected false-positive probability for runtime-filter Bloom "
+        "sketches (sizing follows BloomFilter.optimalNumOfBits). False "
+        "positives only reduce pruning, never correctness.",
+    validator=lambda v: 0.0 < v < 1.0)
+
 ADAPTIVE_ENABLED = register(
     "spark_tpu.sql.adaptive.enabled", True,
     doc="Enable the stats->re-jit retry loop for join/exchange/aggregate "

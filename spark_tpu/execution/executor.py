@@ -39,7 +39,7 @@ class QueryExecution:
         self._optimized: Optional[L.LogicalPlan] = None
         self._executed: Optional[P.PhysicalPlan] = None
         self.phase_times: Dict[str, float] = {}
-        self.last_metrics: Dict[str, int] = {}
+        self.last_metrics: Dict[str, float] = {}  # ints except rtf_build_ms_*
         self.spilled_partial_rows: Optional[int] = None
         # adaptive strategy re-plans (DynamicJoinSelection.scala:1):
         # {join_tag: strategy}, applied by executed_plan on re-plan
@@ -301,9 +301,11 @@ class QueryExecution:
             fn = jax.jit(run)
         else:
             from jax.sharding import PartitionSpec as Psp
-            from jax import shard_map
+            from ..parallel.mesh import shard_map
             from ..parallel import stripe_batch
             from ..parallel.mesh import AXIS
+
+            n = int(mesh.devices.size)
 
             # sorted/limited/global-agg results are replicated on every
             # shard; each shard emits its contiguous stripe so the
@@ -327,7 +329,8 @@ class QueryExecution:
                     # capacity-sizing stats take the worst shard (pmax);
                     # row counts sum across shards
                     red = jax.lax.pmax if k.startswith(
-                        ("join_rows_", "exch_max_", "agg_groups_")) \
+                        ("join_rows_", "exch_max_", "agg_groups_",
+                         "rtf_build_ms_")) \
                         else jax.lax.psum
                     metrics[k] = red(jnp.asarray(v), AXIS)
                 return out, flags, metrics
@@ -475,13 +478,21 @@ class QueryExecution:
 
         t0 = time.perf_counter()
         from ..io.device_cache import load_scan
-        scan_batches = [load_scan(s, self.session.conf)
-                        if isinstance(s, P.ScanExec) else s.load()
-                        for s in scans]
-        if mesh is not None:
-            from ..parallel import pad_batch_to_multiple
-            n = int(mesh.devices.size)
-            scan_batches = [pad_batch_to_multiple(b, n) for b in scan_batches]
+        # dedupe by node identity: a runtime filter's creation chain
+        # shares its leaf with the join build side (the documented DAG),
+        # so the same scan appears twice in `scans` — load and pad it
+        # once, feed the same Batch to both input slots
+        loaded: Dict[int, Batch] = {}
+        for s in scans:
+            if id(s) in loaded:
+                continue
+            b = load_scan(s, self.session.conf) \
+                if isinstance(s, P.ScanExec) else s.load()
+            if mesh is not None:
+                from ..parallel import pad_batch_to_multiple
+                b = pad_batch_to_multiple(b, int(mesh.devices.size))
+            loaded[id(s)] = b
+        scan_batches = [loaded[id(s)] for s in scans]
         self.phase_times["ingest"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -589,7 +600,12 @@ class QueryExecution:
                 store.setdefault(aqe_key, {}).update(converged)
                 while len(store) > 256:
                     store.pop(next(iter(store)))
-        self.last_metrics = {k: int(v) for k, v in metrics.items()}
+        # rtf_build_ms_* is a float (sub-ms filter builds are the
+        # common case) — int() would floor it to a useless 0
+        self.last_metrics = {
+            k: (round(float(v), 3) if k.startswith("rtf_build_ms_")
+                else int(v))
+            for k, v in metrics.items()}
         # fill the data cache on the first action over a marked plan
         fp = self.session._plan_fingerprint(self.logical)
         if fp in self.session._cache_requests and \

@@ -52,6 +52,10 @@ def _match_shape(plan: P.PhysicalPlan):
         if isinstance(node, (P.ProjectExec, P.FilterExec)):
             chain.append(node)
             node = node.children[0]
+        elif isinstance(node, P.RuntimeFilterExec):
+            # pure pruning optimization: safe to drop in the chunked
+            # replay (the join re-checks every key)
+            node = node.children[0]
         elif isinstance(node, P.JoinExec) and node.how in _CHUNKABLE_JOINS:
             chain.append(node)
             node = node.children[0]
@@ -62,12 +66,14 @@ def _match_shape(plan: P.PhysicalPlan):
     return limit, sort, chain, node
 
 
-def _host_sort_keys(sort: P.SortExec, schema) -> Optional[List[Tuple]]:
-    """SortOrders -> pyarrow (name, order, null_placement) keys, or
+def _host_sort_keys(sort: P.SortExec, schema) -> Optional[Tuple]:
+    """SortOrders -> (pyarrow (name, order) keys, null_placement), or
     None when any key is a computed expression (host merge needs the key
-    as a spilled output column)."""
+    as a spilled output column) or null placements are mixed (pyarrow's
+    SortOptions has ONE null_placement for all keys)."""
     from ..expr import Alias, ColumnRef
     keys = []
+    placements = []
     names = set(schema.names)
     for o in sort.orders:
         e = o.child
@@ -76,9 +82,11 @@ def _host_sort_keys(sort: P.SortExec, schema) -> Optional[List[Tuple]]:
         if not isinstance(e, ColumnRef) or e._name not in names:
             return None
         keys.append((e._name,
-                     "ascending" if o.ascending else "descending",
-                     "at_start" if o.nulls_first else "at_end"))
-    return keys
+                     "ascending" if o.ascending else "descending"))
+        placements.append("at_start" if o.nulls_first else "at_end")
+    if not keys or len(set(placements)) > 1:
+        return None  # nothing to merge by / pyarrow can't express it
+    return keys, placements[0]
 
 
 def try_external_collect(session, plan: P.PhysicalPlan, conf,
@@ -174,8 +182,10 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
         b = limit.compute(ctx, [b])
         return b.to_arrow()
     if sort is not None:
+        keys, placement = host_keys
         idx = pc.sort_indices(
-            table, options=pc.SortOptions(sort_keys=host_keys))
+            table, options=pc.SortOptions(sort_keys=keys,
+                                          null_placement=placement))
         return table.take(idx)
     if limit is not None:
         return table.slice(0, limit.n)

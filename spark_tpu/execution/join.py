@@ -160,6 +160,103 @@ def expand(lo, cnt_key, cnt_eff, perm, out_cap: int):
     return p, build_idx, is_pair & valid, valid, total
 
 
+class RuntimeFilter:
+    """A built runtime join filter: Bloom membership over hashed int64
+    keys, plus [lo, hi] value bounds when the key dtype is ordered
+    (numeric/date/timestamp/decimal) — the cheap range rejection that
+    needs two compares instead of k hash probes."""
+
+    def __init__(self, bloom, lo=None, hi=None):
+        self.bloom = bloom
+        self.lo = lo
+        self.hi = hi
+
+
+def _runtime_filter_key(vec: Vec):
+    """(hashed int64 values, validity, ordered) for filter build/probe.
+
+    Dictionary strings map through the per-dictionary VALUE hashes the
+    shuffle uses, so build and probe sides with independently-built
+    dictionaries hash equal strings equally (codes alone would not).
+    `ordered` marks dtypes whose raw values support min/max bounds."""
+    if vec.dictionary is not None:
+        from ..parallel.shuffle import _dict_value_hashes
+        table = _dict_value_hashes(vec.dictionary)
+        if table.shape[0] == 0:
+            # all-NULL / zero-row string column: a 0-entry dictionary
+            # has nothing to take from; validity already masks every
+            # row, so any constant hash is correct
+            return jnp.zeros(vec.data.shape, jnp.int64), vec.validity, \
+                False
+        idx = jnp.clip(vec.data.astype(jnp.int32), 0, table.shape[0] - 1)
+        return jnp.take(table, idx), vec.validity, False
+    ordered = not isinstance(vec.dtype, (T.StringType, T.BooleanType))
+    return vec.data.astype(jnp.int64), vec.validity, ordered
+
+
+def build_runtime_filter(build_batch: Batch, key_expr, ctx,
+                         expected_items: int, fpp: float = 0.03
+                         ) -> RuntimeFilter:
+    """Build a RuntimeFilter from the build-side key column. NULL keys
+    are excluded (they never equi-match). Inside shard_map the per-shard
+    Bloom bits pmax-combine (bitwise OR over the one-bit-per-byte
+    layout) and the bounds pmin/pmax, so the filter covers every
+    shard's build rows while staying replicated."""
+    from ..sketch import BloomFilter
+    vec = key_expr.eval(build_batch)
+    hashed, validity, ordered = _runtime_filter_key(vec)
+    mask = build_batch.selection_mask()
+    if validity is not None:
+        mask = mask & validity
+    bloom = BloomFilter.build(hashed, expected_items=expected_items,
+                              fpp=fpp, mask=mask)
+    lo = hi = None
+    if ordered:
+        raw = vec.data
+        bmask = mask
+        if jnp.issubdtype(raw.dtype, jnp.floating):
+            pos = jnp.asarray(np.inf, raw.dtype)
+            neg = jnp.asarray(-np.inf, raw.dtype)
+            # a valid NaN build key would poison the bounds (NaN
+            # propagates through min/max and every probe compare goes
+            # False — an empty join). NaN never equi-matches anyway
+            # (IEEE), so exclude it from the bounds; NaN probe keys
+            # fail the range compare and prune, consistently with the
+            # join's own equality.
+            bmask = bmask & ~jnp.isnan(raw)
+        else:
+            info = np.iinfo(np.dtype(raw.dtype))
+            pos = jnp.asarray(info.max, raw.dtype)
+            neg = jnp.asarray(info.min, raw.dtype)
+        lo = jnp.min(jnp.where(bmask, raw, pos))
+        hi = jnp.max(jnp.where(bmask, raw, neg))
+    if ctx.axis_name is not None and ctx.n_shards > 1:
+        bloom = BloomFilter(jax.lax.pmax(bloom.bits, ctx.axis_name),
+                            bloom.num_hashes)
+        if lo is not None:
+            lo = jax.lax.pmin(lo, ctx.axis_name)
+            hi = jax.lax.pmax(hi, ctx.axis_name)
+    return RuntimeFilter(bloom, lo, hi)
+
+
+def apply_runtime_filter(filt: RuntimeFilter, probe_batch: Batch,
+                         key_expr):
+    """Per-probe-row keep mask: False is a definite non-match (prune),
+    True is probabilistic (the join still decides). NULL probe keys are
+    pruned — an equi-join never matches them."""
+    vec = key_expr.eval(probe_batch)
+    hashed, validity, ordered = _runtime_filter_key(vec)
+    keep = filt.bloom.might_contain(hashed)
+    if filt.lo is not None and ordered:
+        # range rejection on raw values: an empty build side leaves
+        # lo > hi (the sentinels), which prunes everything — correct
+        # for inner/semi joins
+        keep = keep & (vec.data >= filt.lo) & (vec.data <= filt.hi)
+    if validity is not None:
+        keep = keep & validity
+    return keep
+
+
 def gather_columns(batch: Batch, idx, present,
                    name_map: Sequence[Tuple[str, str]]
                    ) -> List[Tuple[str, Column]]:

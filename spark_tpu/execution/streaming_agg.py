@@ -51,6 +51,11 @@ def find_streamable_chain(agg: "P.HashAggregateExec",
         if isinstance(node, (P.ProjectExec, P.FilterExec)):
             chain.append(node)
             node = node.children[0]
+        elif isinstance(node, P.RuntimeFilterExec):
+            # a runtime filter is a pure pruning optimization: the join
+            # it guards re-checks every key, so the streamed replay can
+            # drop it (chunking already bounds residency)
+            node = node.children[0]
         elif allow_joins and isinstance(node, P.JoinExec) \
                 and node.how in _CHUNKABLE_JOINS:
             chain.append(node)
@@ -511,7 +516,7 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     between chunks."""
     import jax
     from jax.sharding import PartitionSpec as Psp
-    from jax import shard_map
+    from ..parallel.mesh import shard_map
     from ..parallel import pad_batch_to_multiple
     from ..parallel.mesh import AXIS
 

@@ -37,3 +37,34 @@ def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
                     row[k] = v
                 rows.append(row)
     return pd.DataFrame(rows)
+
+
+def runtime_filter_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-(execution, filter) runtime-filter pruning summary from a
+    read_event_log frame: tag, rows tested, rows pruned, pruning ratio
+    and the trace-time build cost — the observability surface of the
+    runtime-filter subsystem (rtf_* metrics emitted by
+    RuntimeFilterExec)."""
+    rows: List[dict] = []
+    tested_cols = [c for c in events.columns
+                   if c.startswith("rtf_tested_")]
+    for _, r in events.iterrows():
+        for c in tested_cols:
+            tag = c[len("rtf_tested_"):]
+            tested = r.get(c)
+            if pd.isna(tested):
+                continue
+            pruned = r.get(f"rtf_pruned_{tag}")
+            rows.append({
+                "ts": r.get("ts"),
+                "app": r.get("app"),
+                "tag": tag,
+                "tested": int(tested),
+                "pruned": None if pd.isna(pruned) else int(pruned),
+                # None (not 0.0) when the pruned metric is absent:
+                # "unknown" must not read as "pruned nothing"
+                "ratio": (float(pruned) / float(tested)
+                          if not pd.isna(pruned) and tested else None),
+                "build_ms": r.get(f"rtf_build_ms_{tag}"),
+            })
+    return pd.DataFrame(rows)

@@ -123,7 +123,10 @@ def _arrow_schema_to_engine(schema: pa.Schema) -> T.Schema:
     for f in schema:
         at = f.type
         if pa.types.is_string(at) or pa.types.is_large_string(at) or \
-                pa.types.is_dictionary(at):
+                pa.types.is_dictionary(at) or pa.types.is_null(at):
+            # arrow `null` = an empty/all-None object column (e.g. a
+            # streaming schema df): STRING is the dtype it would carry
+            # with any value present (columnar casts it the same way)
             dt: T.DataType = T.STRING
         elif pa.types.is_decimal(at):
             dt = T.DecimalType(at.precision, at.scale)

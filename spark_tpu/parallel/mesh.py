@@ -12,6 +12,26 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh
 
+# jax moved shard_map from jax.experimental to the top level around
+# 0.5.x and renamed check_rep -> check_vma; import whichever this jax
+# ships (0.4.37 has only the experimental location) and normalize the
+# kwarg so call sites can always pass check_vma.
+try:
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # newer jax: top-level export only
+    from jax import shard_map as _shard_map
+
+import inspect as _inspect
+
+_SHARD_MAP_PARAMS = frozenset(
+    _inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, *args, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, *args, **kwargs)
+
 AXIS = "data"
 
 

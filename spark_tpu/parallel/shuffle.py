@@ -61,17 +61,19 @@ def _dict_value_hashes(dictionary) -> jnp.ndarray:
     key = id(dictionary)
     cached = _DICT_HASH_CACHE.get(key)
     if cached is not None and cached[0] is dictionary:
-        return cached[1]
+        # convert per call: caching the jnp array would capture a
+        # TRACER when first computed inside a jit trace and leak it
+        # into the next trace over the same dictionary
+        return jnp.asarray(cached[1])
     hs = np.empty(len(dictionary), dtype=np.int64)
     for i, s in enumerate(dictionary.to_pylist()):
         b = (s if s is not None else "\0").encode("utf-8", "surrogatepass")
         hs[i] = int.from_bytes(
             hashlib.blake2b(b, digest_size=8).digest(), "little", signed=True)
-    out = jnp.asarray(hs)
     if len(_DICT_HASH_CACHE) >= _DICT_HASH_CACHE_MAX:
         _DICT_HASH_CACHE.pop(next(iter(_DICT_HASH_CACHE)))
-    _DICT_HASH_CACHE[key] = (dictionary, out)
-    return out
+    _DICT_HASH_CACHE[key] = (dictionary, hs)
+    return jnp.asarray(hs)
 
 
 def _resolve(batch: Batch, name: str) -> Column:

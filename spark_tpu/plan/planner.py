@@ -39,6 +39,9 @@ def plan_physical(plan: L.LogicalPlan, conf: Conf,
         _assign_join_tags(phys)
         _apply_strategy_overrides(phys, join_strategy_overrides)
     phys = ensure_requirements(phys, conf, n)
+    from .runtime_filter import ENABLED_KEY, inject_runtime_filters
+    if bool(conf.get(ENABLED_KEY)):
+        phys = inject_runtime_filters(phys, conf)
     _assign_join_tags(phys)
     return phys
 
@@ -59,8 +62,14 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
 
     agg_counter = [0]
     op_counter = [0]
+    rf_counter = [0]
+    seen = set()  # creation chains are DAG-shared under rf nodes:
+    # tag each node once, or op numbers get burned and overwritten
 
     def walk(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
         for c in node.children:
             walk(c)
         if isinstance(node, P.JoinExec):
@@ -72,6 +81,9 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
         elif isinstance(node, P.HashAggregateExec):
             node.tag = f"a{agg_counter[0]}"
             agg_counter[0] += 1
+        elif isinstance(node, P.RuntimeFilterExec):
+            node.tag = f"rf{rf_counter[0]}"
+            rf_counter[0] += 1
         node.op_tag = f"op{op_counter[0]}"
         op_counter[0] += 1
 

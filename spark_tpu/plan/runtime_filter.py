@@ -1,0 +1,243 @@
+"""Runtime join-filter injection (reference: InjectRuntimeFilter.scala:1).
+
+The planner-side rule of the runtime-filter subsystem: after exchange
+insertion, walk the physical plan and, for each shuffle/broadcast join
+whose build side is selective and small, wrap the probe-side subtree
+(BELOW its exchange) in a `RuntimeFilterExec` that prunes probe rows
+against a device Bloom filter + min/max key bounds built from the
+build-side keys in-stage (execution/join.py kernels over sketch.py).
+
+Creation-side extraction follows the reference's
+`extractSelectiveFilterOverScan`: descend from the join's build child
+through exchanges, joins (into the child the key column originates
+from), aggregates (through group keys), sorts and limits, until a cheap
+Project/Filter-over-leaf chain evaluates the key. Every descent step
+only ever WIDENS the key set (join outputs, aggregate group keys and
+limits are subsets of their origin columns), so the filter built from
+the chain is a superset of the true build keys — pruning stays sound,
+it just prunes less than a perfect filter would.
+
+Injection preconditions:
+- join type is probe-prunable (inner / left_semi: dropping a probe row
+  with no build match cannot change the result);
+- the creation chain is selective (a FilterExec or pushed scan filters
+  — an unfiltered table filters nothing worth the build);
+- estimated creation bytes <= runtimeFilter.creationSideThreshold
+  (the chain is recomputed for the filter, reference-style).
+
+The whole rule is a no-op when spark_tpu.sql.runtimeFilter.enabled is
+false, and plans differ structurally on/off (the compiled-stage cache
+keys on describe(), so toggling recompiles rather than reuses).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional, Tuple
+
+from ..expr import Alias, ColumnRef, Expression
+from . import physical as P
+
+ENABLED_KEY = "spark_tpu.sql.runtimeFilter.enabled"
+THRESHOLD_KEY = "spark_tpu.sql.runtimeFilter.creationSideThreshold"
+FPP_KEY = "spark_tpu.sql.runtimeFilter.expectedFpp"
+
+#: join types where dropping a non-matching probe row preserves results
+_PRUNABLE_JOINS = ("inner", "left_semi")
+
+
+def estimate_rows_physical(node: P.PhysicalPlan) -> Optional[int]:
+    """Upper-bound-ish row estimate over the PHYSICAL tree (the
+    planner.estimate_rows analog after conversion; exchanges and
+    filters pass through, inner joins take the FK max heuristic)."""
+    if isinstance(node, P.ScanExec):
+        return node.source.estimated_rows()
+    if isinstance(node, P.RangeExec):
+        return node.num_rows()
+    if isinstance(node, P.InputExec):
+        return node.load().capacity
+    if isinstance(node, (P.ProjectExec, P.FilterExec, P.SortExec,
+                         P.ExchangeExec, P.WindowExec,
+                         P.HashAggregateExec, P.RuntimeFilterExec)):
+        return estimate_rows_physical(node.children[0])
+    if isinstance(node, P.LimitExec):
+        child = estimate_rows_physical(node.children[0])
+        return node.n if child is None else min(node.n, child)
+    if isinstance(node, P.JoinExec):
+        if node.how in ("left_semi", "left_anti"):
+            return estimate_rows_physical(node.children[0])
+        l = estimate_rows_physical(node.children[0])
+        r = estimate_rows_physical(node.children[1])
+        if node.how == "inner" and l is not None and r is not None:
+            return max(l, r)
+        return None
+    if isinstance(node, P.UnionExec):
+        l = estimate_rows_physical(node.children[0])
+        r = estimate_rows_physical(node.children[1])
+        if l is not None and r is not None:
+            return l + r
+    return None
+
+
+def _plain_name(e: Expression) -> Optional[str]:
+    while isinstance(e, Alias):
+        e = e.child
+    if isinstance(e, ColumnRef):
+        return e.name()
+    return None
+
+
+def _resolves(e: Expression, schema) -> bool:
+    try:
+        e.dtype(schema)
+        return True
+    except Exception:
+        return False
+
+
+def _cheap_chain(node: P.PhysicalPlan) -> bool:
+    """True when the subtree is only Project/Filter over one leaf —
+    cheap enough to recompute for the filter build (the reference
+    bounds its creation side the same way)."""
+    while isinstance(node, (P.ProjectExec, P.FilterExec)):
+        node = node.children[0]
+    return isinstance(node, P.LeafExec)
+
+
+def _chain_selective(node: P.PhysicalPlan) -> bool:
+    """A creation chain is worth a filter only if something narrows it:
+    a residual FilterExec or filters pushed into the scan."""
+    while isinstance(node, (P.ProjectExec, P.FilterExec)):
+        if isinstance(node, P.FilterExec):
+            return True
+        node = node.children[0]
+    return isinstance(node, P.ScanExec) and bool(node.pushed_filters)
+
+
+def _substitute(expr: Expression, mapping: dict) -> Expression:
+    def f(node):
+        if isinstance(node, ColumnRef) and node._name in mapping:
+            return mapping[node._name]
+        return node
+    return expr.transform_up(f)
+
+
+def extract_creation_side(node: P.PhysicalPlan, key: Expression
+                          ) -> Optional[Tuple[P.PhysicalPlan, Expression]]:
+    """Descend from a join's build child to the cheap chain the key
+    column originates from. Returns (creation_plan, key_expr) with the
+    key rewritten to evaluate against creation_plan's output, or None.
+    Every hop preserves the superset property (see module docstring)."""
+    if _cheap_chain(node) and _resolves(key, node.schema()):
+        return node, key
+    if isinstance(node, (P.ExchangeExec, P.SortExec, P.LimitExec,
+                         P.RuntimeFilterExec)):
+        return extract_creation_side(node.children[0], key)
+    if isinstance(node, P.FilterExec):
+        # descending past the filter widens the key set: still sound
+        return extract_creation_side(node.children[0], key)
+    if isinstance(node, P.ProjectExec):
+        mapping = {}
+        for e in node.exprs:
+            if isinstance(e, Alias):
+                mapping[e.name()] = e.child
+            elif isinstance(e, ColumnRef):
+                mapping[e.name()] = e
+        new = _substitute(key, mapping)
+        if _resolves(new, node.children[0].schema()):
+            return extract_creation_side(node.children[0], new)
+        return None
+    if isinstance(node, P.JoinExec):
+        name = _plain_name(key)
+        if name is None:
+            return None
+        left_names = list(node.left.schema().names)
+        if node.how in ("left_semi", "left_anti"):
+            if name in left_names:
+                return extract_creation_side(node.left, ColumnRef(name))
+            return None
+        out_names = list(node.schema().names)
+        if name not in out_names:
+            return None
+        idx = out_names.index(name)
+        n_left = len(left_names)
+        if idx < n_left:
+            return extract_creation_side(node.left,
+                                         ColumnRef(left_names[idx]))
+        right_names = list(node.right.schema().names)
+        if idx - n_left >= len(right_names):
+            return None
+        return extract_creation_side(node.right,
+                                     ColumnRef(right_names[idx - n_left]))
+    if isinstance(node, P.HashAggregateExec):
+        name = _plain_name(key)
+        for g in node.group_exprs:
+            if g.name() != name:
+                continue
+            base = g
+            while isinstance(base, Alias):
+                base = base.child
+            if isinstance(base, ColumnRef):
+                return extract_creation_side(node.children[0],
+                                             ColumnRef(base.name()))
+        return None
+    return None
+
+
+def inject_runtime_filters(plan: P.PhysicalPlan, conf
+                           ) -> P.PhysicalPlan:
+    """Bottom-up walk wrapping eligible joins' probe subtrees (below
+    their exchange) in RuntimeFilterExec nodes. Tags are assigned by
+    the planner's _assign_join_tags pass afterwards."""
+    threshold = int(conf.get(THRESHOLD_KEY))
+    fpp = float(conf.get(FPP_KEY))
+
+    def walk(node):
+        new_children = tuple(walk(c) for c in node.children)
+        if new_children != node.children:
+            node = copy.copy(node)
+            node.children = new_children
+        if isinstance(node, P.JoinExec) and node.how in _PRUNABLE_JOINS:
+            injected = _try_inject(node, threshold, fpp)
+            if injected is not None:
+                node = injected
+        return node
+
+    return walk(plan)
+
+
+def _try_inject(join: P.JoinExec, threshold: int, fpp: float
+                ) -> Optional[P.JoinExec]:
+    probe, build = join.children
+    target = probe.children[0] if isinstance(probe, P.ExchangeExec) \
+        else probe
+    if isinstance(target, P.RuntimeFilterExec):
+        return None  # one filter per probe side
+    for pk, bk in zip(join.left_keys, join.right_keys):
+        found = extract_creation_side(build, bk)
+        if found is None:
+            continue
+        creation, build_key = found
+        if creation is target:
+            continue  # self-filter: the probe IS the creation chain
+        if not _chain_selective(creation):
+            continue
+        rows = estimate_rows_physical(creation)
+        if rows is None:
+            continue
+        width = 8 * max(1, len(creation.schema().fields))
+        if rows * width > threshold:
+            continue
+        if not _resolves(pk, target.schema()):
+            continue
+        rf = P.RuntimeFilterExec(target, creation, pk, build_key,
+                                 est_items=max(int(rows), 8), fpp=fpp)
+        new_join = copy.copy(join)
+        if isinstance(probe, P.ExchangeExec):
+            new_ex = copy.copy(probe)
+            new_ex.children = (rf,)
+            new_join.children = (new_ex, build)
+        else:
+            new_join.children = (rf, build)
+        return new_join
+    return None

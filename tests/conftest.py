@@ -22,7 +22,36 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+_FIXTURE_SESSIONS = []
+
+
 @pytest.fixture(scope="session")
 def session():
     from spark_tpu import SparkTpuSession
-    return SparkTpuSession.builder().get_or_create()
+    s = SparkTpuSession.builder().get_or_create()
+    _FIXTURE_SESSIONS.append(s)
+    return s
+
+
+@pytest.fixture(autouse=True)
+def _session_conf_guard():
+    """Snapshot and restore session conf overrides around EVERY test,
+    so one test's mesh size / kernel mode / threshold mutation (or a
+    failure before its own restore ran) can no longer cascade through
+    the session-scoped fixture into 100+ downstream failures (round-5
+    post-mortem). Guards BOTH the shared fixture session and whatever
+    session is currently active — tests that spin up fresh sessions
+    (e.g. warehouse round-trips) repoint SparkTpuSession._active, and
+    guarding only _active would silently skip the one the tests use."""
+    from spark_tpu.session import SparkTpuSession
+    sessions = []
+    if _FIXTURE_SESSIONS:
+        sessions.append(_FIXTURE_SESSIONS[0])
+    active = SparkTpuSession._active
+    if active is not None and active not in sessions:
+        sessions.append(active)
+    snaps = [(s, dict(s.conf._settings)) for s in sessions]
+    yield
+    for s, snap in snaps:
+        s.conf._settings.clear()
+        s.conf._settings.update(snap)
