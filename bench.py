@@ -17,10 +17,12 @@ against the independent pandas goldens, per-query wall-clock in `extra`
 
 Output is timeout-proof (round-5 ran into the driver's rc:124 with zero
 parseable output): every section prints its OWN complete JSON line the
-moment it finishes (flushed), and each section runs under a SIGALRM
-deadline, so a killed or hung run still leaves one parseable line per
-completed section. The final line keeps the legacy aggregate shape:
-{"metric", "value", "unit", "vs_baseline", "extra"}.
+moment it finishes (flushed), each section runs under a SIGALRM
+deadline, AND the aggregate summary line {"metric", "value", "unit",
+"vs_baseline", "extra"} is rewritten (with "partial": true) after every
+section — a killed or hung run leaves both per-section lines and a
+parseable partial summary. Consumers take the LAST summary-shaped line;
+the final rewrite drops the partial marker.
 """
 
 import contextlib
@@ -308,16 +310,32 @@ def main():
     spark = SparkTpuSession.builder().get_or_create()
     budget = float(os.environ.get("BENCH_SECTION_BUDGET_S", "900"))
 
+    # The aggregate summary is REWRITTEN (one flushed JSON line, marked
+    # "partial": true) after EVERY section, so a global `timeout` kill
+    # mid-run still leaves a parseable summary of each finished section
+    # (BENCH_r05's rc:124 / parsed:null failure mode). The consumer
+    # takes the LAST summary-shaped line; the final rewrite drops the
+    # partial marker and is byte-identical in shape to the legacy line.
+    summary = {"metric": "linear_keys_agg_rows_per_sec", "value": None,
+               "unit": "M rows/s", "vs_baseline": None, "extra": {}}
+    extra = summary["extra"]
+
+    def emit_summary(final=False):
+        out = summary if final else dict(summary, partial=True)
+        print(json.dumps(out), flush=True)
+
     keys = _run_section(
         "linear_keys",
         lambda: {"keys_rows_per_sec_M":
                  round(bench_linear_keys(spark) / 1e6, 1)},
         budget)
     keys_rps = keys.get("keys_rows_per_sec_M")
-
-    extra = {}
+    summary["value"] = keys_rps
+    summary["vs_baseline"] = (round(keys_rps * 1e6 / KEYS_BASELINE, 3)
+                              if keys_rps is not None else None)
     if keys_rps is None:
         extra.update(keys)  # surface the headline failure in the summary
+    emit_summary()
 
     def stddev_section():
         rps = bench_stddev(spark)
@@ -325,19 +343,23 @@ def main():
                 "stddev_vs_baseline": round(rps / STDDEV_BASELINE, 3)}
 
     extra.update(_run_section("stddev", stddev_section, budget))
+    emit_summary()
     extra.update(_run_section(
         "grouped100",
         lambda: {"grouped100_rows_per_sec_M":
                  round(bench_100_groups(spark) / 1e6, 1)},
         budget))
+    emit_summary()
     extra.update(_run_section(
         "kernel_pick", lambda: bench_kernel_pick(spark), budget))
+    emit_summary()
     extra.update(_run_section(
         f"tpch_sf{TPCH_SF:g}",
         lambda: bench_tpch(
             spark, TPCH_SF, TPCH_PATH,
             deadline=time.perf_counter() + budget * 0.9),
         budget))
+    emit_summary()
 
     # SF10: the north-star scale on one chip (VERDICT r4 #2). The
     # device-table cache budget rises so the pruned lineitem goes
@@ -360,14 +382,7 @@ def main():
         extra.update(_run_section("tpch_sf10", sf10_section,
                                   sf10_budget * 1.1))
 
-    print(json.dumps({
-        "metric": "linear_keys_agg_rows_per_sec",
-        "value": keys_rps,
-        "unit": "M rows/s",
-        "vs_baseline": (round(keys_rps * 1e6 / KEYS_BASELINE, 3)
-                        if keys_rps is not None else None),
-        "extra": extra,
-    }), flush=True)
+    emit_summary(final=True)
 
 
 if __name__ == "__main__":

@@ -1,17 +1,22 @@
 #!/usr/bin/env bash
 # Preflight gate: run before committing/snapshotting so the round-5
 # class of "snapshot committed with a broken mesh path" cannot recur.
+# Any stage failing exits this script NONZERO (set -e + explicit rc
+# checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Three stages, all mandatory:
+# Four stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
 #   3. bench smoke: the headline aggregate shape at a reduced size, so
 #      the bench entrypoint itself (imports, section harness, JSON
 #      emission) is known-runnable before the driver spends a TPU slot
+#   4. chaos smoke: one injected OOM + one injected transient against
+#      TPC-H Q1 with golden parity — the failure-recovery ladder
+#      (executor taxonomy + fault injection) must survive end-to-end
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2+3 only) for quick
+#   --fast skips the full pytest suite (stages 2-4 only) for quick
 #   inner-loop checks; CI and end-of-round runs must use the default.
 
 set -euo pipefail
@@ -23,7 +28,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/3: tier-1 test suite --"
+    echo "-- stage 1/4: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -37,16 +42,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/3: SKIPPED (--fast) --"
+    echo "-- stage 1/4: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/3: dryrun_multichip(8) --"
+echo "-- stage 2/4: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/3: bench smoke --"
+echo "-- stage 3/4: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -71,6 +76,42 @@ def smoke():
 out = bench._run_section("bench_smoke", smoke, 300)
 assert out.get("groups") == 256, out
 print(json.dumps({"preflight_bench_smoke": "ok"}))
+EOF
+
+echo "-- stage 4/4: chaos smoke --"
+# One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
+# and one injected transient UNAVAILABLE (backoff retry), then Q1 must
+# still hit golden parity with both recoveries visible in fault_summary.
+env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import tempfile
+import warnings
+
+from spark_tpu import SparkTpuSession
+from spark_tpu.testing import faults
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+spark = SparkTpuSession.builder().get_or_create()
+spark.conf.set("spark_tpu.execution.backoffMs", 1)
+path = tempfile.mkdtemp(prefix="preflight_tpch_") + "/sf"
+write_parquet(path, 0.001)
+Q.register_tables(spark, path)
+
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")  # the retry warnings are the point
+    with faults.inject(
+            spark.conf,
+            "stage_run:resource_exhausted:1,stage_run:unavailable:2"):
+        qe = Q.QUERIES["q1"](spark)._qe()
+        got = G.normalize_decimals(qe.collect().to_pandas())
+assert qe.fault_summary.get("oom_cache_evict", 0) >= 1, qe.fault_summary
+assert qe.fault_summary.get("transient_retry", 0) >= 1, qe.fault_summary
+G.compare(got.reset_index(drop=True), G.GOLDEN["q1"](path))
+print(json.dumps({"preflight_chaos_smoke": "ok",
+                  "fault_summary": {k: v for k, v in
+                                    qe.fault_summary.items()}}))
 EOF
 
 echo "== preflight PASSED =="

@@ -88,6 +88,15 @@ class Conf:
                 or (self._parent is not None and self._parent.contains(key))
                 or key in _REGISTRY)
 
+    def is_explicitly_set(self, key: str) -> bool:
+        """True when the key was set on this conf or any parent overlay
+        (as opposed to merely having a registry default) — the
+        deprecated-alias resolution hook (a legacy key only overrides
+        its successor when a user actually set it)."""
+        return (key in self._settings
+                or (self._parent is not None
+                    and self._parent.is_explicitly_set(key)))
+
     def unset(self, key: str) -> None:
         self._settings.pop(key, None)
 
@@ -143,11 +152,60 @@ STREAMING_CHUNK_ROWS = register(
 
 TASK_MAX_FAILURES = register(
     "spark_tpu.sql.execution.maxTaskFailures", 2,
-    doc="Retries for TRANSIENT runtime/compile failures of a jitted "
-        "stage (e.g. a remote-compile 500 on tunneled runtimes) before "
-        "surfacing the error; compiled-stage caches are dropped so the "
-        "retry recompiles. The spark.task.maxFailures seat — gang SPMD "
-        "retries the whole stage, not one task.")
+    doc="DEPRECATED alias of spark_tpu.execution.maxRetries (kept for "
+        "compatibility): when explicitly set, it overrides maxRetries. "
+        "The spark.task.maxFailures seat — gang SPMD retries the whole "
+        "stage, not one task.")
+
+EXEC_MAX_RETRIES = register(
+    "spark_tpu.execution.maxRetries", 3,
+    doc="Retry budget per query execution for TRANSIENT failures "
+        "(remote-compile 500s, UNAVAILABLE, DEADLINE_EXCEEDED) and "
+        "stage wall-clock timeouts, with exponential backoff + jitter "
+        "(execution/failures.py taxonomy). A transient retry drops the "
+        "failed stage's compiled entry and recompiles; a timeout retry "
+        "keeps it (the program was fine, just slow).")
+
+EXEC_BACKOFF_MS = register(
+    "spark_tpu.execution.backoffMs", 50.0,
+    doc="Base backoff for stage-failure retries: attempt n sleeps "
+        "backoffMs * 2^n * uniform(0.5, 1.0) milliseconds.",
+    validator=lambda v: v >= 0)
+
+EXEC_STAGE_TIMEOUT_MS = register(
+    "spark_tpu.execution.stageTimeoutMs", 0,
+    doc="Per-stage wall-clock deadline (compile + run + stats pull of "
+        "one attempt), checked cooperatively after the attempt's host "
+        "sync. A blown deadline raises StageTimeoutError and retries "
+        "under the maxRetries budget. 0 disables.")
+
+MESH_FALLBACK_ENABLED = register(
+    "spark_tpu.execution.meshFallback.enabled", True,
+    doc="When a distributed run fails inside the mesh/collective path "
+        "(shard_map, all_to_all/all_gather lowering), re-plan the query "
+        "single-device and retry instead of failing — the degraded-mode "
+        "analog of the reference rescheduling tasks off a lost "
+        "executor. The fallback is recorded as a `mesh_fallback` metric "
+        "and in the event log's fault_summary.")
+
+OOM_SPILL_ENABLED = register(
+    "spark_tpu.execution.oom.spillOnExhausted", True,
+    doc="Rung 2 of the RESOURCE_EXHAUSTED degradation ladder: after a "
+        "device-cache eviction retry still OOMs, re-route the query "
+        "through the host-spill chunked paths (execution/external.py / "
+        "streaming partial spill) by re-planning under a 1-byte device "
+        "budget. Disabled, the ladder goes straight from eviction to "
+        "the diagnostic raise.")
+
+FAULT_INJECT = register(
+    "spark_tpu.faults.inject", "",
+    doc="Deterministic fault injection for chaos testing "
+        "(spark_tpu/testing/faults.py): comma-separated "
+        "`site:fault:nth[:arg]` rules, e.g. "
+        "'shuffle:resource_exhausted:2,join_build:unavailable:1' raises "
+        "a synthetic RESOURCE_EXHAUSTED on the 2nd shuffle lowering and "
+        "a synthetic UNAVAILABLE on the 1st join build. Each rule fires "
+        "once. Empty disables (zero overhead).")
 
 SKEW_JOIN_ENABLED = register(
     "spark_tpu.sql.adaptive.skewJoin.enabled", True,

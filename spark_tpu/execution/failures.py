@@ -1,0 +1,145 @@
+"""Structured failure taxonomy + retry policy for stage execution.
+
+The reference's TaskScheduler distinguishes failure kinds and reacts per
+kind — transient task failures retry (`TaskSetManager.scala:1`,
+spark.task.maxFailures), fetch failures resubmit the parent stage
+(`DAGScheduler.scala:1`), OOM kills spill and re-execute. XLA collapses
+all of that into one opaque exception channel; this module restores the
+structure:
+
+- TRANSIENT: infra flakes (remote-compile 500s, UNAVAILABLE,
+  DEADLINE_EXCEEDED) — retried with exponential backoff + jitter
+  (`spark_tpu.execution.{maxRetries,backoffMs}`).
+- TIMEOUT: a stage blew its wall-clock deadline
+  (`spark_tpu.execution.stageTimeoutMs`) — retried like TRANSIENT
+  (a fresh compile/run often clears a wedged runtime).
+- OOM: HBM RESOURCE_EXHAUSTED — handled by the executor's degradation
+  ladder (evict device cache -> reroute through the host-spill chunked
+  path -> diagnostic raise), the UnifiedMemoryManager
+  evict-then-spill discipline with host RAM as the spill tier.
+- OVERFLOW: static-capacity overflow. Never an exception — it flows as
+  flags through the stats channel into the AQE re-jit loop; listed here
+  so the taxonomy is total.
+- FATAL: everything else — surfaces immediately.
+
+Synthetic faults from `spark_tpu.testing.faults` carry their class on
+the exception; real errors classify by message tokens, so both flow
+through one path.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from enum import Enum
+from typing import Optional
+
+
+class FailureClass(Enum):
+    TRANSIENT = "transient"
+    TIMEOUT = "timeout"
+    OOM = "oom"
+    OVERFLOW = "overflow"
+    FATAL = "fatal"
+
+
+class StageTimeoutError(RuntimeError):
+    """A stage attempt exceeded spark_tpu.execution.stageTimeoutMs."""
+
+
+class StageOOMError(RuntimeError):
+    """RESOURCE_EXHAUSTED survived the whole degradation ladder; the
+    message names the stage and its capacity stats."""
+
+
+#: message tokens marking retryable infra flakes (remote-compile 500s on
+#: tunneled runtimes, gRPC channel errors); DEADLINE_EXCEEDED is the
+#: runtime's own deadline, distinct from our stage wall-clock TIMEOUT
+_TRANSIENT_TOKENS = (
+    "remote_compile", "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+    "Connection reset", "Socket closed", "connection attempt",
+)
+
+_OOM_TOKENS = (
+    "RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+    "Allocator ran out", "OOM while allocating",
+)
+
+#: tokens that mark a failure as coming from the COLLECTIVE path at
+#: run/trace time — with meshFallback.enabled the executor re-plans
+#: single-device. Deliberately narrow: a bare "mesh" token would also
+#: swallow get_mesh's pre-dispatch misconfiguration diagnostic
+#: ("mesh.size=N but only M devices visible"), silently degrading a
+#: setup error the user needs to see.
+_MESH_TOKENS = (
+    "shard_map", "all_to_all", "all_gather", "collective", "axis_index",
+    "NCCL",
+)
+
+
+def classify(exc: BaseException) -> FailureClass:
+    """Map an exception to its failure class. Synthetic faults classify
+    by their carried class; real errors by message tokens."""
+    from ..testing.faults import FaultInjected
+    if isinstance(exc, StageTimeoutError):
+        return FailureClass.TIMEOUT
+    if isinstance(exc, FaultInjected):
+        if exc.fault == "resource_exhausted":
+            return FailureClass.OOM
+        if exc.fault in ("unavailable", "deadline"):
+            return FailureClass.TRANSIENT
+        return FailureClass.FATAL
+    if isinstance(exc, MemoryError):
+        return FailureClass.OOM
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(t in msg for t in _OOM_TOKENS):
+        return FailureClass.OOM
+    if any(t in msg for t in _TRANSIENT_TOKENS):
+        return FailureClass.TRANSIENT
+    return FailureClass.FATAL
+
+
+def is_mesh_failure(exc: BaseException) -> bool:
+    """True when the failure points at the mesh/collective path (or a
+    synthetic fault at the `mesh` site): the candidate set for the
+    single-device fallback re-plan."""
+    from ..testing.faults import FaultInjected
+    if isinstance(exc, FaultInjected):
+        return exc.site == "mesh"
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(t in msg for t in _MESH_TOKENS)
+
+
+class RetryPolicy:
+    """One retry budget per query execution, shared by every failure
+    class that retries (TRANSIENT and TIMEOUT): exponential backoff with
+    jitter, the unified replacement for the ad-hoc fixed-count transient
+    loop (spark.task.maxFailures seat).
+
+    delay_n = backoff_ms * 2^n * uniform(0.5, 1.0)
+    """
+
+    def __init__(self, max_retries: int, backoff_ms: float,
+                 sleep=time.sleep, rng: Optional[random.Random] = None):
+        self.max_retries = max(0, int(max_retries))
+        self.remaining = self.max_retries
+        self.backoff_ms = max(0.0, float(backoff_ms))
+        self.attempts = 0
+        self.total_sleep_ms = 0.0
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    def attempt_retry(self) -> Optional[float]:
+        """Consume one retry and sleep the backoff. Returns the slept
+        milliseconds, or None when the budget is exhausted (caller must
+        surface the error)."""
+        if self.remaining <= 0:
+            return None
+        delay_ms = self.backoff_ms * (2 ** self.attempts)
+        delay_ms *= 0.5 + self._rng.random() * 0.5
+        if delay_ms > 0:
+            self._sleep(delay_ms / 1e3)
+        self.attempts += 1
+        self.remaining -= 1
+        self.total_sleep_ms += delay_ms
+        return delay_ms

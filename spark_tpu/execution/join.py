@@ -38,6 +38,8 @@ def build_sorted(key: Vec, sel) -> Tuple:
     """Sort build side by key; invalid rows pushed to the end.
 
     Returns (sorted_keys, perm, num_valid, valid_mask_sorted)."""
+    from ..testing import faults
+    faults.fire("join_build")  # chaos seam: fires at trace time
     cap = key.data.shape[0]
     invalid = jnp.zeros((cap,), jnp.int8)
     if sel is not None:

@@ -35,7 +35,49 @@ def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
                     row[f"phase_{k}_s"] = v
                 for k, v in (e.get("metrics") or {}).items():
                     row[k] = v
+                for k, v in (e.get("fault_summary") or {}).items():
+                    # recovery counters flatten to fault_* columns; the
+                    # per-event record list stays nested
+                    row[f"fault_{k}"] = v
                 rows.append(row)
+    return pd.DataFrame(rows)
+
+
+#: recovery-action counters an execution's fault_summary may carry
+#: (executor._record_fault actions + the aggregate backoff total)
+FAULT_ACTIONS = ("transient_retry", "stage_timeout", "oom_cache_evict",
+                 "oom_spill_reroute", "mesh_fallback")
+
+
+def fault_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-execution failure-recovery summary from a read_event_log
+    frame: one row per execution that survived at least one fault, with
+    the count of each recovery action (retries, cache evictions, spill
+    reroutes, mesh fallbacks, stage timeouts), the total backoff slept,
+    and the bounded per-fault event records — the observability surface
+    of the degradation ladder (execution/failures.py)."""
+    rows: List[dict] = []
+    cols = [c for c in events.columns if c.startswith("fault_")]
+    if not cols:
+        return pd.DataFrame(rows)
+
+    def present(v) -> bool:
+        if isinstance(v, (list, dict)):
+            return True  # nested event records (pd.isna chokes on lists)
+        return not pd.isna(v)
+
+    for _, r in events.iterrows():
+        acted = {c: r.get(c) for c in cols if present(r.get(c))}
+        if not any(c != "fault_events" for c in acted):
+            continue
+        row = {"ts": r.get("ts"), "app": r.get("app")}
+        for a in FAULT_ACTIONS:
+            v = acted.get(f"fault_{a}")
+            row[a] = 0 if v is None else int(v)
+        bk = acted.get("fault_retry_backoff_ms")
+        row["retry_backoff_ms"] = 0.0 if bk is None else float(bk)
+        row["events"] = acted.get("fault_events") or []
+        rows.append(row)
     return pd.DataFrame(rows)
 
 

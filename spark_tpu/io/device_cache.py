@@ -46,6 +46,9 @@ class DeviceTableCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        #: entries dropped by budget pressure or OOM-ladder clears (the
+        #: storage-eviction observable; never reset with clear())
+        self.evictions = 0
 
     def get(self, key) -> Optional[object]:
         entry = self._entries.get(key)
@@ -68,6 +71,7 @@ class DeviceTableCache:
         while self._bytes > budget and len(self._entries) > 1:
             _, (_, evicted) = self._entries.popitem(last=False)
             self._bytes -= evicted
+            self.evictions += 1
 
     def invalidate_token(self, token) -> None:
         """Drop every entry whose source stamp is `token`."""
@@ -76,6 +80,7 @@ class DeviceTableCache:
             self._bytes -= nbytes
 
     def clear(self) -> None:
+        self.evictions += len(self._entries)
         self._entries.clear()
         self._bytes = 0
 

@@ -255,6 +255,8 @@ class ArrowTableSource(TableSource):
         return self.table.num_rows
 
     def load(self, required_columns, pushed_filters) -> Batch:
+        from ..testing import faults
+        faults.fire("scan_load")  # chaos seam: host->HBM ingest edge
         t = self.table
         for f in pushed_filters:
             ae = expr_to_arrow(f, self.table.schema)
@@ -340,6 +342,8 @@ class ParquetSource(TableSource):
             return None
 
     def load(self, required_columns, pushed_filters) -> Batch:
+        from ..testing import faults
+        faults.fire("scan_load")  # chaos seam: host->HBM ingest edge
         ae = None
         for f in pushed_filters:
             e = expr_to_arrow(f, self._dataset.schema)

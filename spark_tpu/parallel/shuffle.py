@@ -150,6 +150,8 @@ def _exchange_by_target(batch: Batch, tgt, ctx, block: int,
     """Route each selected row to shard `tgt[row]` via scatter +
     all_to_all; surfaces the max per-bucket count for the executor's
     capacity-retry loop."""
+    from ..testing import faults
+    faults.fire("shuffle")  # chaos seam: fires at trace time, per compile
     n = ctx.n_shards
     axis = ctx.axis_name
     sel = batch.selection_mask()

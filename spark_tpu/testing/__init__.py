@@ -1,0 +1,4 @@
+"""Test-support subsystems that ship with the engine (not the test
+suite): deterministic fault injection for chaos testing lives in
+`spark_tpu.testing.faults` — the ChaosMonkey/`FailureSafeParser` seat,
+sized to a single-process SPMD engine."""

@@ -1,0 +1,183 @@
+"""Conf-driven deterministic fault injection for chaos testing.
+
+The reference exercises its failure machinery with test-only hooks
+(`TaskSchedulerImplSuite`, `FetchFailedException` fixtures, the
+`spark.test.*` knobs); an XLA engine has no task boundaries to kill, so
+this module plants NAMED INJECTION POINTS at the host-side seams of
+stage execution — scan ingest, stage compile, stage dispatch, shuffle
+lowering, join builds, the mesh path — and arms them from one conf
+string:
+
+    spark_tpu.faults.inject = "shuffle:resource_exhausted:2,join_build:unavailable:1"
+
+Grammar (comma-separated rules):
+
+    rule  := site ":" fault ":" nth [":" arg]
+    site  := scan_load | stage_compile | stage_run | shuffle
+             | join_build | mesh   (any string; these are the built-ins)
+    fault := resource_exhausted | unavailable | deadline | fatal | slow
+    nth   := 1-based hit count of `site` at which the rule fires
+    arg   := fault argument (only `slow`: sleep milliseconds, default 100)
+
+Each rule fires exactly ONCE (later hits of the same site pass), so a
+retry loop that re-executes the site deterministically succeeds — the
+chaos suite proves recovery, not permanent outage. Multiple rules on one
+site with different `nth` model repeated failures.
+
+Raising faults carry messages shaped like the real XLA/PJRT errors
+("RESOURCE_EXHAUSTED: ...", "UNAVAILABLE: ..."), so the executor's
+failure taxonomy (execution/failures.py) classifies synthetic and real
+errors through the same path. `slow` sleeps instead of raising — the
+deterministic trigger for the stage wall-clock deadline
+(spark_tpu.execution.stageTimeoutMs).
+
+Sites fire at Python execution time: host-side sites (scan_load,
+stage_run) fire on every pass; in-trace sites (shuffle, join_build) fire
+at TRACE time, i.e. once per (re)compile of the enclosing stage — the
+executor drops the failed stage's compiled entry on retry, so the retry
+re-traces and the site counts deterministically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+INJECT_KEY = "spark_tpu.faults.inject"
+
+#: raising fault classes -> message templates shaped like real errors
+_MESSAGES = {
+    "resource_exhausted":
+        "RESOURCE_EXHAUSTED: injected: out of memory while allocating "
+        "device buffer at {site} (hit {n})",
+    "unavailable":
+        "UNAVAILABLE: injected: backend endpoint unreachable at "
+        "{site} (hit {n})",
+    "deadline":
+        "DEADLINE_EXCEEDED: injected: operation deadline exceeded at "
+        "{site} (hit {n})",
+    "fatal":
+        "INTERNAL: injected: unrecoverable failure at {site} (hit {n})",
+}
+
+FAULT_CLASSES = tuple(_MESSAGES) + ("slow",)
+
+
+class FaultInjected(Exception):
+    """Synthetic error raised by an armed injection point. Carries the
+    site and fault class so the taxonomy can classify without string
+    matching (real errors still classify by message tokens)."""
+
+    def __init__(self, site: str, fault: str, message: str):
+        super().__init__(message)
+        self.site = site
+        self.fault = fault
+
+
+@dataclass
+class _Rule:
+    site: str
+    fault: str
+    nth: int
+    arg: Optional[float] = None
+    fired: bool = False
+
+
+def _parse(spec: str) -> List[_Rule]:
+    rules = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) not in (3, 4):
+            raise ValueError(
+                f"bad fault rule {part!r}: want site:fault:nth[:arg]")
+        site, fault = bits[0].strip(), bits[1].strip()
+        if fault not in FAULT_CLASSES:
+            raise ValueError(
+                f"unknown fault class {fault!r} in {part!r}; "
+                f"known: {FAULT_CLASSES}")
+        nth = int(bits[2])
+        if nth < 1:
+            raise ValueError(f"hit count must be >= 1 in {part!r}")
+        arg = float(bits[3]) if len(bits) == 4 else None
+        rules.append(_Rule(site, fault, nth, arg))
+    return rules
+
+
+class FaultPlan:
+    """Parsed spec + per-site hit counters + a log of fired rules."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.rules = _parse(spec)
+        self.hits = {}
+        self.fired_log: List[Tuple[str, int, str]] = []
+
+    def fire(self, site: str) -> None:
+        n = self.hits.get(site, 0) + 1
+        self.hits[site] = n
+        for r in self.rules:
+            if r.fired or r.site != site or r.nth != n:
+                continue
+            r.fired = True
+            self.fired_log.append((site, n, r.fault))
+            if r.fault == "slow":
+                time.sleep((r.arg if r.arg is not None else 100.0) / 1e3)
+                continue
+            raise FaultInjected(
+                site, r.fault, _MESSAGES[r.fault].format(site=site, n=n))
+
+
+#: the single armed plan (the driver is single-threaded, like the
+#: session conf activation in executor._activate_conf)
+_PLAN: Optional[FaultPlan] = None
+
+
+def arm(conf) -> None:
+    """Arm/disarm from conf. Called at every execute_batch entry: an
+    unchanged spec KEEPS its hit counters (multi-execution scenarios
+    count across queries); a changed spec starts fresh."""
+    global _PLAN
+    spec = str(conf.get(INJECT_KEY) or "").strip()
+    if not spec:
+        _PLAN = None
+        return
+    if _PLAN is None or _PLAN.spec != spec:
+        _PLAN = FaultPlan(spec)
+
+
+def reset() -> None:
+    """Drop the armed plan and its hit counters."""
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def fire(site: str) -> None:
+    """The injection point: no-op unless a plan is armed. Cheap enough
+    to sit on hot paths (one None check when disarmed)."""
+    if _PLAN is not None:
+        _PLAN.fire(site)
+
+
+@contextlib.contextmanager
+def inject(conf, spec: str):
+    """Scoped injection for tests: set the conf spec with FRESH hit
+    counters, restore and disarm on exit. Yields the armed FaultPlan so
+    assertions can inspect `fired_log`."""
+    old = conf.get(INJECT_KEY)
+    conf.set(INJECT_KEY, spec)
+    reset()
+    arm(conf)
+    try:
+        yield active()
+    finally:
+        conf.set(INJECT_KEY, old if old else "")
+        reset()

@@ -15,14 +15,20 @@ import pandas as pd
 import pyarrow.parquet as pq
 
 
-def _read(path: str, name: str) -> pd.DataFrame:
-    df = pq.read_table(os.path.join(path, f"{name}.parquet")).to_pandas()
+def normalize_decimals(df: pd.DataFrame) -> pd.DataFrame:
+    """Cast Decimal object columns to float (in place, returned for
+    chaining) — the shared normalization for pandas reference arithmetic
+    and for comparing engine output against the goldens."""
     for c in df.columns:
-        # decimals -> float for the pandas reference arithmetic
         if df[c].dtype == object and len(df) and \
                 df[c].iloc[0].__class__.__name__ == "Decimal":
             df[c] = df[c].astype(float)
     return df
+
+
+def _read(path: str, name: str) -> pd.DataFrame:
+    df = pq.read_table(os.path.join(path, f"{name}.parquet")).to_pandas()
+    return normalize_decimals(df)
 
 
 def q1(path: str) -> pd.DataFrame:

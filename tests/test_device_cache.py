@@ -78,6 +78,68 @@ def test_budget_eviction(session, tmp_path):
         session.conf.set(key_budget, prev)
 
 
+def test_eviction_squeeze_recompute_parity(session, tmp_path):
+    """Byte-budget squeeze: a budget holding ~1 of 3 tables churns the
+    LRU across a query loop — every reload recomputes the evicted batch
+    from source and results stay correct (evict-then-recompute parity),
+    and the eviction counter proves the squeeze actually evicted."""
+    key_budget = "spark_tpu.sql.io.deviceCacheBytes"
+    prev = session.conf.get(key_budget)
+    try:
+        session.conf.set(key_budget, 48 << 10)  # each table is ~32KB
+        paths, want = [], []
+        for i in range(3):
+            p = str(tmp_path / f"sq{i}.parquet")
+            v = np.arange(4000, dtype=np.int64) + i
+            pq.write_table(pa.table({"v": v}), p)
+            paths.append(p)
+            want.append(int(v.sum()))
+        ev0 = CACHE.evictions
+        for _round in range(3):
+            for i, p in enumerate(paths):
+                got = session.read_parquet(p).agg(
+                    F.sum(col("v")).alias("s")).to_pandas()["s"][0]
+                assert int(got) == want[i], (i, _round)
+        assert CACHE.evictions > ev0  # budget pressure did evict
+        assert CACHE.nbytes <= 48 << 10
+    finally:
+        session.conf.set(key_budget, prev)
+
+
+def test_rewrite_detected_through_eviction_churn(session, tmp_path):
+    """A parquet rewrite (same row count/byte size, fresh mtime) must
+    miss the cache even while budget pressure is churning entries — the
+    (size, mtime_ns) stamp is re-checked on every load, so an
+    evict-reload cycle can never resurrect stale data."""
+    key_budget = "spark_tpu.sql.io.deviceCacheBytes"
+    prev = session.conf.get(key_budget)
+    p = str(tmp_path / "target.parquet")
+    other = str(tmp_path / "churn.parquet")
+
+    def total(path):
+        return int(session.read_parquet(path).agg(
+            F.sum(col("v")).alias("s")).to_pandas()["s"][0])
+
+    try:
+        session.conf.set(key_budget, 48 << 10)
+        pq.write_table(pa.table({"v": np.arange(1000, dtype=np.int64)}), p)
+        pq.write_table(pa.table(
+            {"v": np.arange(4000, dtype=np.int64)}), other)
+        assert total(p) == sum(range(1000))
+        total(other)  # churn: the big table evicts the target entry
+        # rewrite with the SAME shape/size but shifted values: only the
+        # mtime stamp distinguishes old from new
+        pq.write_table(pa.table(
+            {"v": np.arange(1000, dtype=np.int64) + 7}), p)
+        assert total(p) == sum(range(1000)) + 7 * 1000
+        # and a rewrite while the entry is STILL cached also misses
+        pq.write_table(pa.table(
+            {"v": np.arange(1000, dtype=np.int64) + 11}), p)
+        assert total(p) == sum(range(1000)) + 11 * 1000
+    finally:
+        session.conf.set(key_budget, prev)
+
+
 def test_cache_disabled_matches(session, tmp_path):
     p = str(tmp_path / "t.parquet")
     pq.write_table(pa.table({"k": np.arange(100, dtype=np.int64) % 3,

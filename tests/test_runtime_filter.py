@@ -273,6 +273,47 @@ def test_tpch_golden_parity_on_off(session, tmp_path_factory, qname):
     G.compare(got, want)
 
 
+def test_pruned_counts_shrink_static_caps(tables):
+    """ROADMAP runtime-filter item (c): after a converged run, the
+    pruned-row counts re-seed the guarded join's output capacity DOWN
+    (survivor-sized, floored by the measured join_rows), so the next
+    compile of the same plan allocates smaller buffers even on a single
+    chip — pruning used to pay off only in ICI traffic."""
+    from spark_tpu.plan import physical as P
+
+    qe = _selective_join(tables)._qe()
+    qe.execute_batch()
+    tested = qe.last_metrics["rtf_tested_rf0"]
+    pruned = qe.last_metrics["rtf_pruned_rf0"]
+    assert tested == 20000 and pruned > 0
+
+    joins = []
+
+    def walk(n):
+        for c in n.children:
+            walk(c)
+        if isinstance(n, P.JoinExec):
+            joins.append(n)
+
+    walk(qe.executed_plan)
+    assert len(joins) == 1
+    # the probe capacity would seed >= 20000; survivors bound it lower
+    assert joins[0].out_cap is not None and joins[0].out_cap < tested, \
+        joins[0].out_cap
+    # the shrunk cap persists through the AQE store and a rerun of the
+    # same plan stays correct with no overflow ramp
+    qe2 = _selective_join(tables)._qe()
+    _, flags, _ = qe2.execute_batch()
+    assert not any(bool(v) for k, v in flags.items()
+                   if k.startswith("join_overflow_")), flags
+    got = _selective_join(tables).to_pandas() \
+        .sort_values("v").reset_index(drop=True)
+    tables.conf.set(RTF_KEY, False)
+    want = _selective_join(tables).to_pandas() \
+        .sort_values("v").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+
+
 def test_event_log_carries_rtf_metrics(tables, tmp_path):
     from spark_tpu import history
     log_dir = str(tmp_path / "events")
