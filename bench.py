@@ -255,6 +255,26 @@ def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
     write_parquet(path, sf)
     Q.register_tables(spark, path)
     extra = {}
+    # XLA cost/HBM sidecars (flops, bytes accessed, peak HBM demand per
+    # query) ride along with the wall-clock rows, so BENCH rounds form a
+    # real perf trajectory: time deltas become attributable to compute
+    # vs movement vs memory pressure. Capture pays one extra analysis
+    # compile per stage key (memoized session-wide), on the warmup run.
+    cost_key = "spark_tpu.sql.observability.xlaCost"
+    old_cost_mode = spark.conf.get(cost_key)
+    spark.conf.set(cost_key, "on")
+    try:
+        return _bench_tpch_queries(spark, sf, queries, float_atol,
+                                   deadline, path, extra)
+    finally:
+        spark.conf.set(cost_key, old_cost_mode)
+
+
+def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
+                        extra):
+    from spark_tpu.tpch import golden as G
+    from spark_tpu.tpch import queries as Q
+
     for name in queries:
         if deadline is not None and time.perf_counter() > deadline:
             extra[f"tpch_{name}_sf{sf:g}_skipped"] = "time budget"
@@ -280,6 +300,19 @@ def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
             if phase in qe.phase_times:
                 extra[f"tpch_{name}_{phase}_ms"] = round(
                     qe.phase_times[phase] * 1e3, 1)
+        # XLA cost/HBM accounting sidecar (observability/xla_cost.py):
+        # total flops + bytes accessed across the query's compiled
+        # stages, and the worst single-stage peak HBM demand
+        costs = [c for c in qe.stage_costs.values()
+                 if c.get("flops") is not None
+                 or c.get("peak_hbm_bytes") is not None]
+        if costs:
+            extra[f"tpch_{name}_sf{sf:g}_flops"] = int(
+                sum(c.get("flops") or 0 for c in costs))
+            extra[f"tpch_{name}_sf{sf:g}_xla_bytes"] = int(
+                sum(c.get("bytes_accessed") or 0 for c in costs))
+            extra[f"tpch_{name}_sf{sf:g}_peak_hbm_bytes"] = int(max(
+                c.get("peak_hbm_bytes") or 0 for c in costs))
         # runtime-filter observability: fraction of probe rows the
         # injected Bloom/min-max filters pruned before the exchanges
         tested = sum(v for k, v in qe.last_metrics.items()
@@ -290,10 +323,7 @@ def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
             extra[f"tpch_{name}_sf{sf:g}_rtf_pruned_ratio"] = round(
                 pruned / tested, 4)
         # result parity vs the independent pandas implementation
-        for c in got.columns:
-            if len(got) and got[c].dtype == object and \
-                    got[c].iloc[0].__class__.__name__ == "Decimal":
-                got[c] = got[c].astype(float)
+        got = G.normalize_decimals(got)
         want = G.GOLDEN[name](path)
         if name == "q5":
             got = got.sort_values("n_name").reset_index(drop=True)

@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Four stages, all mandatory:
+# Six stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -14,9 +14,17 @@
 #   4. chaos smoke: one injected OOM + one injected transient against
 #      TPC-H Q1 with golden parity — the failure-recovery ladder
 #      (executor taxonomy + fault injection) must survive end-to-end
+#   5. observability smoke: TPC-H Q1 with eventLog + trace + Prometheus
+#      sinks on; the event line (spans + XLA cost fields), the Chrome
+#      trace JSON and the metrics exposition file must all exist and
+#      parse — the observability layer must never be the thing that
+#      breaks a query
+#   6. metrics lint: every ctx.add_metric name statically matches a
+#      registered prefix (scripts/metrics_lint.py), so history
+#      summaries can't silently miss columns
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-4 only) for quick
+#   --fast skips the full pytest suite (stages 2-6 still run) for quick
 #   inner-loop checks; CI and end-of-round runs must use the default.
 
 set -euo pipefail
@@ -28,7 +36,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/4: tier-1 test suite --"
+    echo "-- stage 1/6: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -42,16 +50,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/4: SKIPPED (--fast) --"
+    echo "-- stage 1/6: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/4: dryrun_multichip(8) --"
+echo "-- stage 2/6: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/4: bench smoke --"
+echo "-- stage 3/6: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -78,7 +86,7 @@ assert out.get("groups") == 256, out
 print(json.dumps({"preflight_bench_smoke": "ok"}))
 EOF
 
-echo "-- stage 4/4: chaos smoke --"
+echo "-- stage 4/6: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -113,5 +121,59 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                   "fault_summary": {k: v for k, v in
                                     qe.fault_summary.items()}}))
 EOF
+
+echo "-- stage 5/6: observability smoke --"
+env JAX_PLATFORMS=cpu python - <<'EOF2'
+import json
+import os
+import tempfile
+
+from spark_tpu import SparkTpuSession
+from spark_tpu.observability.metrics import parse_prometheus
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+spark = SparkTpuSession.builder().get_or_create()
+base = tempfile.mkdtemp(prefix="preflight_obs_")
+spark.conf.set("spark_tpu.sql.eventLog.dir", base + "/events")
+spark.conf.set("spark_tpu.sql.trace.dir", base + "/traces")
+spark.conf.set("spark_tpu.sql.metrics.sink", "jsonl,prometheus")
+spark.conf.set("spark_tpu.sql.metrics.dir", base + "/metrics")
+
+path = base + "/sf"
+write_parquet(path, 0.001)
+Q.register_tables(spark, path)
+qe = Q.QUERIES["q1"](spark)._qe()
+got = G.normalize_decimals(qe.collect().to_pandas())
+G.compare(got.reset_index(drop=True), G.GOLDEN["q1"](path))
+
+# (a) event line with spans + XLA cost fields
+from spark_tpu import history
+events = history.read_event_log(base + "/events")
+assert len(events) >= 1, events
+stages = history.compile_summary(events)
+assert len(stages) >= 1 and stages["flops"].notna().any(), stages
+assert len(history.stage_summary(events)) >= 3
+assert len(history.hbm_summary(events)) >= 1
+
+# (b) Chrome trace parses and has complete events
+traces = [f for f in os.listdir(base + "/traces")
+          if f.endswith(".trace.json")]
+assert traces, os.listdir(base + "/traces")
+t = json.load(open(os.path.join(base + "/traces", traces[-1])))
+assert t["traceEvents"] and any(e.get("ph") == "X"
+                                for e in t["traceEvents"])
+
+# (c) Prometheus exposition scrape-parses
+prom = parse_prometheus(base + "/metrics/metrics.prom")
+assert prom.get("spark_tpu_queries_total", 0) >= 1, prom
+print(json.dumps({"preflight_observability_smoke": "ok",
+                  "stages": int(len(stages)),
+                  "trace_events": len(t["traceEvents"])}))
+EOF2
+
+echo "-- stage 6/6: metrics lint --"
+env JAX_PLATFORMS=cpu python scripts/metrics_lint.py
 
 echo "== preflight PASSED =="

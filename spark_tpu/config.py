@@ -309,9 +309,61 @@ PROFILE_DIR = register(
 EVENT_LOG_DIR = register(
     "spark_tpu.sql.eventLog.dir", "",
     doc="When set, append one JSON line per query execution (plan "
-        "fingerprint, phase timings, per-operator metrics) to "
-        "<dir>/app-<pid>.jsonl — the EventLoggingListener analog; read "
-        "back with spark_tpu.history.read_event_log.")
+        "fingerprint, phase timings, per-operator metrics, spans, XLA "
+        "stage costs, fault summary) to <dir>/app-<session>.jsonl — "
+        "the EventLoggingListener analog; read back with "
+        "spark_tpu.history.read_event_log.")
+
+EVENT_LOG_MAX_BYTES = register(
+    "spark_tpu.sql.eventLog.maxBytes", 0,
+    doc="Event-log rotation threshold: when the live app-<session>.jsonl "
+        "reaches this size, it rolls to app-<session>.N.jsonl and a "
+        "fresh live file starts (read_event_log replays rolled files in "
+        "N order). 0 disables rotation (unbounded file, the reference's "
+        "spark.eventLog.rolling.enabled=false default).")
+
+TRACE_DIR = register(
+    "spark_tpu.sql.trace.dir", "",
+    doc="When set, write one Chrome-trace-event JSON per query "
+        "execution (<dir>/query-<session>-<id>.trace.json) covering the "
+        "per-stage spans: analysis -> optimize -> plan -> compile -> "
+        "ingest -> dispatch -> AQE-replan -> retry. Load in Perfetto "
+        "or chrome://tracing.")
+
+METRICS_SINK = register(
+    "spark_tpu.sql.metrics.sink", "",
+    doc="Comma-separated metrics sinks flushed at every query end: "
+        "'jsonl' (snapshot lines appended to metrics.jsonl) and/or "
+        "'prometheus' (text exposition atomically rewritten to "
+        "metrics.prom, scrapeable via a textfile collector). Empty "
+        "disables. The MetricsSystem/sink-configuration analog.",
+    validator=lambda v: all(
+        s.strip() in ("jsonl", "prometheus")
+        for s in str(v).split(",") if s.strip()))
+
+METRICS_DIR = register(
+    "spark_tpu.sql.metrics.dir", "spark-metrics",
+    doc="Output directory for the metrics sinks "
+        "(spark_tpu.sql.metrics.sink).")
+
+XLA_COST_MODE = register(
+    "spark_tpu.sql.observability.xlaCost", "auto",
+    doc="Capture XLA cost_analysis()/memory_analysis() (flops, bytes "
+        "accessed, argument/output/temp sizes, derived peak-HBM demand) "
+        "per compiled stage, memoized per stage key. Capture pays a "
+        "second XLA compile of the stage (the jit and AOT paths don't "
+        "share executables), hence the gate: 'auto' captures only when "
+        "an observability output is configured (eventLog.dir, "
+        "trace.dir, metrics.sink) or the OOM ladder is descending (so "
+        "the rung-3 diagnostic can cite measured HBM demand); 'on' "
+        "always; 'off' never.",
+    validator=lambda v: v in ("auto", "on", "off"))
+
+MAX_SPANS = register(
+    "spark_tpu.sql.observability.maxSpans", 1000,
+    doc="Per-query bound on recorded lifecycle spans (a pathological "
+        "retry loop must not grow the trace unboundedly; the recorder "
+        "counts what it drops).")
 
 CHECKPOINT_DIR = register(
     "spark_tpu.sql.checkpoint.dir", "",

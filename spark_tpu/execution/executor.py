@@ -33,6 +33,7 @@ class _ReplanRequest(Exception):
 
 class QueryExecution:
     def __init__(self, session, logical: L.LogicalPlan):
+        from ..observability import SpanRecorder
         self.session = session
         self.logical = logical
         self._analyzed: Optional[L.LogicalPlan] = None
@@ -40,6 +41,18 @@ class QueryExecution:
         self._executed: Optional[P.PhysicalPlan] = None
         self.phase_times: Dict[str, float] = {}
         self.last_metrics: Dict[str, float] = {}  # ints except rtf_build_ms_*
+        # observability: lifecycle identity + per-phase spans (Chrome
+        # -trace exportable) + the XLA cost/memory analysis of every
+        # stage this execution compiled or reused (observability/)
+        self.query_id: int = session._next_query_id()
+        self.spans = SpanRecorder(
+            self.query_id,
+            max_spans=int(session.conf.get(
+                "spark_tpu.sql.observability.maxSpans")))
+        self.stage_costs: Dict[str, dict] = {}
+        # set per execute_batch: False keeps event construction off the
+        # hot path when nothing is listening
+        self._observe_events = False
         self.spilled_partial_rows: Optional[int] = None
         # adaptive strategy re-plans (DynamicJoinSelection.scala:1):
         # {join_tag: strategy}, applied by executed_plan on re-plan
@@ -77,7 +90,9 @@ class QueryExecution:
             self._activate_conf()
             self.logical.schema()  # eager name/type resolution raises here
             self._analyzed = self.logical
-            self.phase_times["analysis"] = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.phase_times["analysis"] = t1 - t0
+            self.spans.record("analysis", t0, t1)
         return self._analyzed
 
     def _apply_cache(self, plan: L.LogicalPlan) -> L.LogicalPlan:
@@ -154,7 +169,9 @@ class QueryExecution:
             plan = self._apply_cache(self.analyzed)
             plan = self._resolve_scalar_subqueries(plan)
             self._optimized = default_optimizer().execute(plan)
-            self.phase_times["optimization"] = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.phase_times["optimization"] = t1 - t0
+            self.spans.record("optimize", t0, t1)
         return self._optimized
 
     @property
@@ -164,7 +181,9 @@ class QueryExecution:
             self._executed = plan_physical(
                 self.optimized_plan, self._conf,
                 join_strategy_overrides=self._join_overrides or None)
-            self.phase_times["planning"] = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            self.phase_times["planning"] = t1 - t0
+            self.spans.record("plan", t0, t1)
         return self._executed
 
     def explain(self, extended: bool = False, runtime: bool = False) -> str:
@@ -176,16 +195,55 @@ class QueryExecution:
         if runtime and self.last_metrics:
             out.append("== Physical Plan (runtime metrics) ==")
             out.append(self._runtime_tree(self.executed_plan))
+            if self.stage_costs:
+                out.append("== Stage cost (XLA) ==")
+                for info in self.stage_costs.values():
+                    bits = [f"stage {info.get('key_hash', '?')}"]
+                    for k, label in (("flops", "flops"),
+                                     ("bytes_accessed", "bytes"),
+                                     ("peak_hbm_bytes", "peak HBM")):
+                        if info.get(k) is not None:
+                            bits.append(f"{label}={info[k]:,}")
+                    if info.get("analysis_ms") is not None:
+                        bits.append(f"analysis={info['analysis_ms']}ms")
+                    out.append("  " + " ".join(bits))
         else:
             out += ["== Physical Plan ==",
                     self.executed_plan.tree_string()]
         return "\n".join(out)
 
     def _runtime_tree(self, node: P.PhysicalPlan, depth: int = 0) -> str:
-        """Tree annotated with per-operator output rows (the SQL-UI plan
-        graph analog of `metric/SQLMetrics.scala:40`)."""
-        rows = self.last_metrics.get(f"rows_{getattr(node, 'op_tag', '')}")
-        note = f"   [rows out: {rows:,}]" if rows is not None else ""
+        """Tree annotated with per-operator runtime observables (the
+        SQL-UI plan graph analog of `metric/SQLMetrics.scala:40`):
+        output rows everywhere, plus join actual-vs-capacity, exchange
+        max-bucket-vs-capacity, and runtime-filter pruned/tested."""
+        m = self.last_metrics
+        notes = []
+        rows = m.get(f"rows_{getattr(node, 'op_tag', '')}")
+        if rows is not None:
+            notes.append(f"rows out: {rows:,}")
+        tag = getattr(node, "tag", None)
+        if isinstance(node, P.JoinExec):
+            jr = m.get(f"join_rows_{tag}")
+            if jr is not None:
+                cap = node.out_cap
+                notes.append(f"join rows: {jr:,}"
+                             + (f"/{cap:,} cap" if cap else ""))
+        elif isinstance(node, P.ExchangeExec):
+            mx = m.get(f"exch_max_{tag}")
+            if mx is not None:
+                cap = node.block_cap
+                notes.append(f"exch max: {mx:,}"
+                             + (f"/{cap:,} cap" if cap else ""))
+            er = m.get(f"exch_rows_{tag}")
+            if er is not None:
+                notes.append(f"exch rows: {er:,}")
+        elif isinstance(node, P.RuntimeFilterExec):
+            tested = m.get(f"rtf_tested_{tag}")
+            pruned = m.get(f"rtf_pruned_{tag}")
+            if tested is not None and pruned is not None:
+                notes.append(f"rtf pruned: {pruned:,}/{tested:,}")
+        note = f"   [{'; '.join(notes)}]" if notes else ""
         line = "  " * depth + node.simple_string() + note
         return "\n".join([line] + [self._runtime_tree(c, depth + 1)
                                    for c in node.children])
@@ -280,14 +338,83 @@ class QueryExecution:
                 + (f"#mesh{n}" if mesh is not None else "")
                 + f"#m{int(metrics_on)}")
 
-    def _compile_stage(self, root: P.PhysicalPlan, mesh=None):
+    def _events_enabled(self) -> bool:
+        """Whether lifecycle events are worth constructing at all: an
+        observability output is configured, or a non-built-in listener
+        is registered. With neither, posting would render plan strings
+        and span dicts per query for three subscribers that each check
+        conf and do nothing — pure hot-path waste."""
+        conf = self.session.conf
+        if str(conf.get("spark_tpu.sql.eventLog.dir")) \
+                or str(conf.get("spark_tpu.sql.trace.dir")) \
+                or str(conf.get("spark_tpu.sql.metrics.sink")):
+            return True
+        return any(not getattr(li, "_builtin", False)
+                   for li in self.session.listeners.listeners)
+
+    def _observe_cost(self) -> bool:
+        """Gate for XLA cost/memory capture (it costs a second compile
+        of the stage): 'on' always, 'off' never, 'auto' only when an
+        observability output is configured or the OOM ladder is
+        descending (the rung-3 diagnostic cites measured HBM)."""
+        conf = self._conf
+        mode = str(conf.get("spark_tpu.sql.observability.xlaCost"))
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        return bool(str(self.session.conf.get("spark_tpu.sql.eventLog.dir"))
+                    or str(self.session.conf.get("spark_tpu.sql.trace.dir"))
+                    or str(self.session.conf.get(
+                        "spark_tpu.sql.metrics.sink"))
+                    or self._oom_rung > 0)
+
+    def _capture_stage_cost(self, fn, key: str, args) -> Optional[dict]:
+        """cost_analysis()/memory_analysis() per stage key, memoized on
+        the session (a stage recompiles only when its key changes, so
+        the analysis stays valid). Fault injection is suppressed around
+        the analysis lowering: it re-traces the stage, and trace-time
+        chaos sites must count once per REAL compile."""
+        import hashlib
+        from ..observability import xla_cost
+        from ..testing import faults
+        info = self.session._stage_costs.get(key)
+        if info is None and args is not None and self._observe_cost():
+            t0 = time.perf_counter()
+            with faults.suppressed():
+                info = xla_cost.analyze_jit(fn, args)
+            info["analysis_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 1)
+            info["key_hash"] = hashlib.md5(
+                key.encode()).hexdigest()[:10]
+            info["stage"] = key[:160]
+            if "error" not in info:
+                # memoize successes only: a failed analysis (e.g. the
+                # analysis compile itself OOMed mid-ladder) must retry
+                # next time instead of pinning the error forever
+                store = self.session._stage_costs
+                store[key] = info
+                while len(store) > 512:
+                    store.pop(next(iter(store)))
+        if info is not None:
+            self.stage_costs[key] = info
+        return info
+
+    def _compile_stage(self, root: P.PhysicalPlan, mesh=None, args=None):
+        from ..observability.listener import StageCompiledEvent
         from ..testing import faults
         conf = self._conf
         key = self._stage_key(root, mesh)
         self._last_stage_key = key  # recovery evicts exactly this entry
         fn = self.session._stage_cache.get(key)
         if fn is not None:
+            self.session.metrics.counter("compile_cache_hits").inc()
+            self._capture_stage_cost(fn, key, args)
+            self._last_compile_was_miss = False
             return fn
+        self.session.metrics.counter("compile_cache_misses").inc()
+        self._last_compile_was_miss = True
+        t_compile = time.perf_counter()
         faults.fire("stage_compile")  # chaos seam: pre-jit, cache miss
 
         per_op = bool(conf.get("spark_tpu.sql.metrics.enabled"))
@@ -363,6 +490,24 @@ class QueryExecution:
                 out_specs=(Psp(AXIS), Psp(), Psp()),
                 check_vma=False))
         self.session._stage_cache[key] = fn
+        cost = self._capture_stage_cost(fn, key, args)
+        t1 = time.perf_counter()
+        # honesty note: jax.jit is lazy — the EXECUTING program's XLA
+        # compile happens inside the first dispatch (that dispatch span
+        # carries includes_jit_compile=True). This span covers stage
+        # setup plus, when capture is on, the AOT analysis compile
+        # (whose wall-clock rides in the analysis_ms attr).
+        attrs = {"stage": (cost or {}).get("key_hash", key[:60])}
+        if cost and cost.get("analysis_ms") is not None:
+            attrs["analysis_ms"] = cost["analysis_ms"]
+        self.spans.record("compile", t_compile, t1, **attrs)
+        if self._observe_events:
+            self.session.listeners.post(
+                "on_stage_compiled", StageCompiledEvent(
+                    query_id=self.query_id, ts=time.time(), stage_key=key,
+                    key_hash=(cost or {}).get("key_hash", ""),
+                    mesh_n=int(mesh.devices.size) if mesh is not None else 1,
+                    cost=cost))
         return fn
 
     def _aqe_cache_key(self, mesh) -> Optional[str]:
@@ -453,6 +598,7 @@ class QueryExecution:
         retry with backoff, RESOURCE_EXHAUSTED descends the degradation
         ladder, mesh failures re-plan single-device — all recorded in
         `fault_summary` and the event log."""
+        from ..observability.listener import QueryStartEvent
         from ..testing import faults
         from .failures import RetryPolicy
         self._activate_conf()
@@ -464,6 +610,11 @@ class QueryExecution:
         self._retry_policy = RetryPolicy(
             max_retries=self._max_retries(conf),
             backoff_ms=float(conf.get("spark_tpu.execution.backoffMs")))
+        self._observe_events = self._events_enabled()
+        if self._observe_events:
+            self.session.listeners.post("on_query_start", QueryStartEvent(
+                query_id=self.query_id, ts=time.time(),
+                plan=self.logical.tree_string()))
         self.session._exec_depth += 1
         try:
             for _replan in range(4):
@@ -471,9 +622,15 @@ class QueryExecution:
                     return self._execute_recover()
                 except _ReplanRequest:
                     self._executed = None  # re-plan with _join_overrides
+                    self.spans.mark("aqe_replan", kind="join_strategy")
             # replan budget exhausted: finish with capacity growth only
             self._no_more_replans = True
             return self._execute_recover()
+        except _ReplanRequest:
+            raise
+        except Exception as e:  # noqa: BLE001 — observe, then surface
+            self._post_query_end(None, status="error", error=e)
+            raise
         finally:
             self.session._exec_depth -= 1
             if self.session._exec_depth == 0:
@@ -494,18 +651,26 @@ class QueryExecution:
     # -- failure recovery ---------------------------------------------------
 
     def _record_fault(self, action: str, exc=None, **extra) -> None:
-        """Count one recovery action into fault_summary and append a
-        bounded event record (both land in the event log)."""
+        """Count one recovery action into fault_summary, append a
+        bounded event record (both land in the event log), post the
+        typed FaultEvent, and mark the retry on the span trace."""
+        from ..observability.listener import FaultEvent
         self.fault_summary[action] = int(self.fault_summary.get(action, 0)) + 1
+        error = "" if exc is None else f"{type(exc).__name__}: {exc}"[:200]
+        site = getattr(exc, "site", None)
         if len(self.fault_events) < 32:
             ev = {"action": action}
             if exc is not None:
-                ev["error"] = f"{type(exc).__name__}: {exc}"[:200]
-                site = getattr(exc, "site", None)
+                ev["error"] = error
                 if site is not None:
                     ev["site"] = site
             ev.update(extra)
             self.fault_events.append(ev)
+        self.spans.mark(f"retry:{action}", error=error[:120])
+        if self._observe_events:
+            self.session.listeners.post("on_fault", FaultEvent(
+                query_id=self.query_id, ts=time.time(), action=action,
+                error=error, site=site))
 
     def _execute_recover(self) -> Tuple[Batch, Dict, Dict]:
         """Run `_execute_batch_inner` under the failure taxonomy: each
@@ -626,13 +791,34 @@ class QueryExecution:
         except Exception:  # noqa: BLE001 — best-effort diagnostics only
             pass
         from ..io.device_cache import CACHE
+        from ..observability import xla_cost
         conf = self._conf
         stage = (self._last_stage_key or "<uncompiled>")[:400]
+        # measured HBM demand (memory_analysis of the failing stage) vs
+        # device capacity — the blind spot this layer exists to close:
+        # the ladder's rung order can now be tuned against numbers
+        hbm = "n/a (enable spark_tpu.sql.observability.xlaCost)"
+        cost = self.session._stage_costs.get(self._last_stage_key or "") \
+            or self.stage_costs.get(self._last_stage_key or "")
+        if cost and cost.get("peak_hbm_bytes") is None:
+            err = cost.get("error") or cost.get("memory_error")
+            if err:
+                hbm = f"capture failed: {err}"
+        if cost and cost.get("peak_hbm_bytes") is not None:
+            cap = xla_cost.device_hbm_capacity()
+            hbm = (f"measured peak HBM demand "
+                   f"{cost['peak_hbm_bytes']:,} bytes "
+                   f"(args={cost.get('argument_bytes', 0):,}, "
+                   f"temps={cost.get('temp_bytes', 0):,}, "
+                   f"out={cost.get('output_bytes', 0):,}) vs "
+                   f"device capacity "
+                   + (f"{cap:,} bytes" if cap else "unknown"))
         return (
             f"RESOURCE_EXHAUSTED survived the degradation ladder "
             f"(device-cache evict -> host-spill reroute): "
             f"{type(e).__name__}: {str(e)[:200]}\n"
             f"  stage: {stage}\n"
+            f"  hbm: {hbm}\n"
             f"  capacity stats (kind:tag -> rows): {caps or 'n/a'}\n"
             f"  deviceCacheBytes={CACHE.nbytes}, "
             f"deviceBudget={conf.get('spark_tpu.sql.memory.deviceBudget')}, "
@@ -670,6 +856,7 @@ class QueryExecution:
         if root is not root0:
             # chunked ingest + chunk compute happen inside the splice
             self.phase_times["streaming"] = dt
+            self.spans.record("streaming", t0, t0 + dt)
         scans: List[P.LeafExec] = []
         self._collect_scans(root, scans)
 
@@ -690,7 +877,9 @@ class QueryExecution:
                 b = pad_batch_to_multiple(b, int(mesh.devices.size))
             loaded[id(s)] = b
         scan_batches = [loaded[id(s)] for s in scans]
-        self.phase_times["ingest"] = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self.phase_times["ingest"] = t1 - t0
+        self.spans.record("ingest", t0, t1, scans=len(scans))
 
         t0 = time.perf_counter()
         token = None
@@ -711,17 +900,25 @@ class QueryExecution:
                 # them (execution/failures.py) and retries/degrades —
                 # the unified spark.task.maxFailures seat
                 t_att = time.perf_counter()
-                fn = self._compile_stage(root, mesh)
+                args = (scan_batches,) if mesh is None \
+                    else (scan_batches, token)
+                fn = self._compile_stage(root, mesh, args)
+                t_disp = time.perf_counter()
                 faults.fire("stage_run")  # chaos seam: pre-dispatch
-                if mesh is None:
-                    batch, flags, metrics = fn(scan_batches)
-                else:
-                    batch, flags, metrics = fn(scan_batches, token)
+                batch, flags, metrics = fn(*args)
                 # ONE batched host pull for the whole stats channel —
                 # per-scalar np.asarray costs an RPC round trip each on
                 # tunneled runtimes (it also syncs the attempt, making
                 # the wall-clock deadline check below honest)
                 flags, metrics = jax.device_get((flags, metrics))
+                # jit compiles lazily: the first dispatch after a stage
+                # -cache miss pays trace + XLA compile in-line, so flag
+                # it — trace readers must not read that as execution
+                self.spans.record(
+                    "dispatch", t_disp, time.perf_counter(),
+                    attempt=_attempt,
+                    includes_jit_compile=getattr(
+                        self, "_last_compile_was_miss", False))
                 if timeout_ms > 0:
                     att_ms = (time.perf_counter() - t_att) * 1e3
                     if att_ms > timeout_ms:
@@ -735,8 +932,11 @@ class QueryExecution:
                                              "exch_overflow_",
                                              "agg_overflow_"))
                             and bool(v)]
+                self._post_stage_completed(_attempt, t_att, metrics,
+                                           overflow)
                 if not overflow:
                     break
+                self.spans.mark("aqe_overflow", flags=overflow[:8])
                 # unique-build fallback is a correctness re-plan, not a
                 # capacity growth — never gated by the adaptive conf
                 if not adaptive and any(
@@ -915,41 +1115,83 @@ class QueryExecution:
 
         walk(root, ())
 
-    def _log_event(self, root: P.PhysicalPlan) -> None:
-        """Append one JSON line per execution when eventLog.dir is set
-        (the `EventLoggingListener.scala:50` event-stream analog; replay
-        with spark_tpu.history.read_event_log)."""
-        log_dir = str(self.session.conf.get("spark_tpu.sql.eventLog.dir"))
-        if not log_dir:
+    def _post_stage_completed(self, attempt: int, t_att: float,
+                              metrics: Dict, overflow: List[str]) -> None:
+        from ..observability.listener import StageCompletedEvent
+        if not self._observe_events:
             return
-        import json
-        import os
+        cost = self.stage_costs.get(self._last_stage_key or "")
+        self.session.listeners.post(
+            "on_stage_completed", StageCompletedEvent(
+                query_id=self.query_id, ts=time.time(),
+                stage_key=self._last_stage_key or "",
+                key_hash=(cost or {}).get("key_hash", ""),
+                attempt=attempt,
+                elapsed_ms=round((time.perf_counter() - t_att) * 1e3, 2),
+                metrics=metrics, overflow=list(overflow)))
+
+    def _build_event(self, root: Optional[P.PhysicalPlan],
+                     status: str = "ok", error=None) -> Dict:
+        """The event-log record for this execution: one dict, JSON-line
+        serializable (sinks.json_default covers numpy/JAX scalars)."""
+        from ..observability import xla_cost
+        from ..observability.sinks import EVENT_LOG_SCHEMA_VERSION
+        event = {
+            "schema_version": EVENT_LOG_SCHEMA_VERSION,
+            "query_id": self.query_id,
+            "ts": time.time(),
+            "status": status,
+            "plan": root.describe() if root is not None else
+            self.logical.tree_string(),
+            "phase_times_s": {k: round(v, 4)
+                              for k, v in self.phase_times.items()},
+            "metrics": self.last_metrics,
+        }
+        if error is not None:
+            event["error"] = f"{type(error).__name__}: {error}"[:300]
+        if self.spans.spans:
+            event["spans"] = self.spans.to_dicts()
+            if self.spans.dropped:
+                event["spans_dropped"] = self.spans.dropped
+        if self.stage_costs:
+            # per-stage XLA cost/memory accounting (history.hbm_summary
+            # / compile_summary read these)
+            event["stages"] = list(self.stage_costs.values())
+            cap = xla_cost.device_hbm_capacity()
+            if cap is not None:
+                event["device_hbm_capacity_bytes"] = cap
+        if self.fault_summary:
+            # every retry/eviction/degradation/fallback this
+            # execution survived (history.fault_summary reads these)
+            event["fault_summary"] = dict(
+                self.fault_summary,
+                retry_backoff_ms=round(
+                    self._retry_policy.total_sleep_ms, 1)
+                if self._retry_policy is not None else 0.0,
+                events=self.fault_events)
+        return event
+
+    def _post_query_end(self, root: Optional[P.PhysicalPlan],
+                        status: str = "ok", error=None) -> None:
+        from ..observability.listener import QueryEndEvent
+        if not self._observe_events:
+            return
         try:
-            os.makedirs(log_dir, exist_ok=True)
-            event = {
-                "ts": time.time(),
-                "plan": root.describe(),
-                "phase_times_s": {k: round(v, 4)
-                                  for k, v in self.phase_times.items()},
-                "metrics": self.last_metrics,
-            }
-            if self.fault_summary:
-                # every retry/eviction/degradation/fallback this
-                # execution survived (history.fault_summary reads these)
-                event["fault_summary"] = dict(
-                    self.fault_summary,
-                    retry_backoff_ms=round(
-                        self._retry_policy.total_sleep_ms, 1)
-                    if self._retry_policy is not None else 0.0,
-                    events=self.fault_events)
-            path = os.path.join(log_dir, f"app-{os.getpid()}.jsonl")
-            with open(path, "a") as f:
-                f.write(json.dumps(event) + "\n")
-        except OSError as e:
-            # never fail a completed query over observability I/O
-            # (the reference's listener logs and continues likewise)
+            event = self._build_event(root, status, error)
+        except Exception as e:  # noqa: BLE001 — observability only
             import warnings
-            warnings.warn(f"event log write failed: {e}")
+            warnings.warn(f"event build failed: {e}")
+            return
+        self.session.listeners.post("on_query_end", QueryEndEvent(
+            query_id=self.query_id, ts=event["ts"], status=status,
+            event=event, spans=self.spans))
+
+    def _log_event(self, root: P.PhysicalPlan) -> None:
+        """Publish the execution's event record on the listener bus
+        (the `EventLoggingListener.scala:50` event-stream analog — the
+        JSONL writer, Chrome-trace writer, and metrics sinks are all
+        subscribers; replay with spark_tpu.history.read_event_log)."""
+        self._post_query_end(root, status="ok")
 
     def collect(self) -> pa.Table:
         ext = self._try_external_collect()

@@ -12,17 +12,43 @@ from __future__ import annotations
 import glob
 import json
 import os
-from typing import List, Optional
+import re
+from typing import List, Optional, Tuple
 
 import pandas as pd
+
+#: basename shape of event-log files: app-<stem>.jsonl (live) and
+#: app-<stem>.<N>.jsonl (rolled by eventLog.maxBytes)
+_LOG_NAME = re.compile(r"^app-(?P<stem>.+?)(?:\.(?P<n>\d+))?\.jsonl$")
+
+
+def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
+    """Event-log files in replay order: per app stem, rolled files in
+    roll-index order, the live (unsuffixed) file last — so a rotated
+    log replays its lines in write order."""
+    entries: List[Tuple[str, int, str]] = []
+    for path in glob.glob(os.path.join(log_dir, "app-*.jsonl")):
+        m = _LOG_NAME.match(os.path.basename(path))
+        if m is None:
+            continue
+        stem, n = m.group("stem"), m.group("n")
+        if app is not None and stem != app:
+            continue
+        # live file sorts after every rolled index
+        entries.append((stem, int(n) if n is not None else 1 << 62, path))
+    return [p for _, _, p in sorted(entries)]
+
+
+#: event fields kept nested (object columns) rather than flattened
+_NESTED = ("spans", "stages")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
     """All logged query executions as a flat DataFrame (one row per
-    execution: ts, plan, per-phase seconds, metric columns)."""
-    pattern = os.path.join(log_dir, f"app-{app or '*'}.jsonl")
+    execution: ts, plan, status, per-phase seconds, metric columns,
+    plus nested `spans`/`stages` object columns when logged)."""
     rows: List[dict] = []
-    for path in sorted(glob.glob(pattern)):
+    for path in _log_paths(log_dir, app):
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -31,6 +57,13 @@ def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
                 e = json.loads(line)
                 row = {"ts": e.get("ts"), "plan": e.get("plan"),
                        "app": os.path.basename(path)}
+                for k in ("query_id", "status", "schema_version",
+                          "device_hbm_capacity_bytes", "error"):
+                    if k in e:
+                        row[k] = e[k]
+                for k in _NESTED:
+                    if k in e:
+                        row[k] = e[k]
                 for k, v in (e.get("phase_times_s") or {}).items():
                     row[f"phase_{k}_s"] = v
                 for k, v in (e.get("metrics") or {}).items():
@@ -78,6 +111,124 @@ def fault_summary(events: pd.DataFrame) -> pd.DataFrame:
         row["retry_backoff_ms"] = 0.0 if bk is None else float(bk)
         row["events"] = acted.get("fault_events") or []
         rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def stage_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-(execution, span) lifecycle timing from a read_event_log
+    frame: one row per recorded span (analysis/optimize/plan/compile/
+    ingest/dispatch/retries), with start offset and duration — the
+    stage-timeline view of the SQL UI, as a DataFrame."""
+    rows: List[dict] = []
+    if "spans" not in events.columns:
+        return pd.DataFrame(rows)
+    for _, r in events.iterrows():
+        spans = r.get("spans")
+        if not isinstance(spans, list):
+            continue
+        for s in spans:
+            rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                         "query_id": r.get("query_id"),
+                         "span": s.get("name"),
+                         "t0_ms": s.get("t0_ms"),
+                         "dur_ms": s.get("dur_ms"),
+                         "attrs": s.get("attrs") or {}})
+    return pd.DataFrame(rows)
+
+
+def compile_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-(execution, compiled stage) XLA cost accounting: flops,
+    bytes accessed, argument/output/temp sizes, peak HBM demand and
+    the analysis-compile cost — from the `stages` records the executor
+    captures via cost_analysis()/memory_analysis()."""
+    rows: List[dict] = []
+    if "stages" not in events.columns:
+        return pd.DataFrame(rows)
+    for _, r in events.iterrows():
+        stages = r.get("stages")
+        if not isinstance(stages, list):
+            continue
+        for s in stages:
+            rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                         "query_id": r.get("query_id"),
+                         "stage": s.get("key_hash"),
+                         "flops": s.get("flops"),
+                         "bytes_accessed": s.get("bytes_accessed"),
+                         "argument_bytes": s.get("argument_bytes"),
+                         "output_bytes": s.get("output_bytes"),
+                         "temp_bytes": s.get("temp_bytes"),
+                         "peak_hbm_bytes": s.get("peak_hbm_bytes"),
+                         "analysis_ms": s.get("analysis_ms")})
+    return pd.DataFrame(rows)
+
+
+def hbm_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-execution HBM headroom: the max per-stage peak demand
+    (memory_analysis) against the device capacity when known — the
+    'how close was this query to RESOURCE_EXHAUSTED' view the OOM
+    ladder is tuned from."""
+    rows: List[dict] = []
+    if "stages" not in events.columns:
+        return pd.DataFrame(rows)
+    for _, r in events.iterrows():
+        stages = r.get("stages")
+        if not isinstance(stages, list):
+            continue
+        peaks = [s.get("peak_hbm_bytes") for s in stages
+                 if s.get("peak_hbm_bytes") is not None]
+        if not peaks:
+            continue
+        peak = max(peaks)
+        worst = next(s for s in stages
+                     if s.get("peak_hbm_bytes") == peak)
+        cap = r.get("device_hbm_capacity_bytes")
+        cap = None if pd.isna(cap) else int(cap)
+        rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                     "query_id": r.get("query_id"),
+                     "plan": r.get("plan"),
+                     "n_stages": len(stages),
+                     "peak_hbm_bytes": int(peak),
+                     "peak_stage": worst.get("key_hash"),
+                     "argument_bytes": worst.get("argument_bytes"),
+                     "temp_bytes": worst.get("temp_bytes"),
+                     "output_bytes": worst.get("output_bytes"),
+                     "capacity_bytes": cap,
+                     "headroom_ratio": (round(peak / cap, 4)
+                                        if cap else None)})
+    return pd.DataFrame(rows)
+
+
+def compare_runs(base: pd.DataFrame, other: pd.DataFrame,
+                 on: str = "plan") -> pd.DataFrame:
+    """Compare two read_event_log frames (e.g. two BENCH rounds, or
+    before/after a conf change): for each key present in both, the
+    LAST execution's numeric columns side by side with delta and
+    ratio. The regression-hunting view of the replay store."""
+    rows: List[dict] = []
+    if base.empty or other.empty or on not in base.columns \
+            or on not in other.columns:
+        return pd.DataFrame(rows)
+    # whole last ROW per key — groupby().last() would take the last
+    # NON-NULL per column, splicing values from different executions
+    b_last = base.drop_duplicates(subset=[on], keep="last").set_index(on)
+    o_last = other.drop_duplicates(subset=[on], keep="last").set_index(on)
+    numeric = [c for c in b_last.columns
+               if c in o_last.columns
+               and pd.api.types.is_numeric_dtype(b_last[c])
+               and pd.api.types.is_numeric_dtype(o_last[c])]
+    for key in b_last.index.intersection(o_last.index):
+        for c in numeric:
+            bv, ov = b_last.at[key, c], o_last.at[key, c]
+            if pd.isna(bv) and pd.isna(ov):
+                continue
+            rows.append({
+                on: key, "column": c,
+                "base": None if pd.isna(bv) else float(bv),
+                "other": None if pd.isna(ov) else float(ov),
+                "delta": (None if pd.isna(bv) or pd.isna(ov)
+                          else float(ov) - float(bv)),
+                "ratio": (None if pd.isna(bv) or pd.isna(ov) or not bv
+                          else round(float(ov) / float(bv), 4))})
     return pd.DataFrame(rows)
 
 

@@ -88,6 +88,13 @@ class DeviceTableCache:
     def nbytes(self) -> int:
         return self._bytes
 
+    def stats(self) -> Dict[str, int]:
+        """Observability snapshot (the metrics listener publishes these
+        as device_cache_* gauges at every query end)."""
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "bytes": self._bytes,
+                "entries": len(self._entries)}
+
 
 #: process-level cache (the session is effectively a singleton; HBM is a
 #: process resource either way, like the reference's block manager)

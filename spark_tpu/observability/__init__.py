@@ -1,0 +1,41 @@
+"""Observability layer: listener bus, spans, XLA cost accounting, metrics.
+
+The reference splits observability across a typed listener event stream
+(`SparkListener` / `EventLoggingListener.scala`), per-operator
+`SQLMetrics`, the SQL UI status store (`SQLAppStatusListener`), and the
+codahale-backed `MetricsSystem` with pluggable sinks. This package is
+the engine-sized analog, organized the same way:
+
+- ``listener``: the typed event stream. ``QueryListener`` is the
+  SparkListener seat (on_query_start / on_stage_compiled /
+  on_stage_completed / on_fault / on_query_end); ``ListenerBus``
+  delivers events so the event log, the Chrome-trace writer, the
+  metrics sinks, and tests are all just subscribers.
+- ``spans``: per-stage spans (analysis -> optimize -> plan -> compile
+  -> ingest -> dispatch -> AQE-replan -> retry) with a wall-clock
+  anchor, exportable as Chrome trace-event JSON (Perfetto-loadable).
+- ``xla_cost``: XLA cost/HBM accounting off the AOT API
+  (``compiled.cost_analysis()`` / ``memory_analysis()``) — flops,
+  bytes accessed, argument/output/temp sizes and the derived peak-HBM
+  demand per compiled stage.
+- ``metrics``: process metrics registry (counters/gauges/timers) with
+  JSONL + Prometheus text-exposition sinks, plus the registered
+  traced-metric name prefixes ``scripts/metrics_lint.py`` enforces.
+- ``sinks``: the built-in bus subscribers (event-log writer with
+  rotation, Chrome-trace writer, metrics-sink updater) a session
+  installs at construction.
+"""
+
+from .listener import (FaultEvent, ListenerBus, QueryEndEvent,
+                       QueryListener, QueryStartEvent, StageCompiledEvent,
+                       StageCompletedEvent)
+from .metrics import (METRIC_PREFIXES, MetricsRegistry,
+                      is_registered_metric)
+from .spans import Span, SpanRecorder, to_chrome_trace
+
+__all__ = [
+    "FaultEvent", "ListenerBus", "MetricsRegistry", "METRIC_PREFIXES",
+    "QueryEndEvent", "QueryListener", "QueryStartEvent", "Span",
+    "SpanRecorder", "StageCompiledEvent", "StageCompletedEvent",
+    "is_registered_metric", "to_chrome_trace",
+]

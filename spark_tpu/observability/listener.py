@@ -1,0 +1,145 @@
+"""Typed query-lifecycle event stream + listener bus.
+
+The SparkListener analog (`SparkListenerBus` / `LiveListenerBus.scala`):
+the executor posts typed events at query-lifecycle boundaries and every
+subscriber — the event-log writer, the Chrome-trace writer, the metrics
+sinks, user listeners, tests — observes the same stream. A listener
+raising can never fail a query: the bus isolates callbacks, warns, and
+counts the drop (the reference logs and continues likewise).
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class QueryStartEvent:
+    """Posted when execute_batch enters (once per execution, nested
+    subquery executions included — query_id disambiguates)."""
+
+    query_id: int
+    ts: float
+    plan: str
+
+
+@dataclass
+class StageCompiledEvent:
+    """Posted on a compiled-stage cache MISS (the stage was actually
+    jitted). `cost` carries the XLA cost/memory analysis when capture
+    is on (observability.xlaCost), else None."""
+
+    query_id: int
+    ts: float
+    stage_key: str
+    key_hash: str
+    mesh_n: int
+    cost: Optional[Dict] = None
+
+
+@dataclass
+class StageCompletedEvent:
+    """Posted after each successful stage dispatch (one per AQE
+    capacity attempt; `overflow` lists the flags that forced another
+    attempt, empty on the converged one)."""
+
+    query_id: int
+    ts: float
+    stage_key: str
+    key_hash: str
+    attempt: int
+    elapsed_ms: float
+    metrics: Dict = field(default_factory=dict)
+    overflow: List[str] = field(default_factory=list)
+
+
+@dataclass
+class FaultEvent:
+    """Posted for every recovery action the failure ladder takes
+    (transient retry, stage timeout, OOM rung, mesh fallback)."""
+
+    query_id: int
+    ts: float
+    action: str
+    error: str = ""
+    site: Optional[str] = None
+
+
+@dataclass
+class QueryEndEvent:
+    """Posted when an execution finishes (status 'ok') or fails past
+    recovery (status 'error'). `event` is the full event-log record —
+    plan, phase times, metrics, spans, stage costs, fault summary."""
+
+    query_id: int
+    ts: float
+    status: str
+    event: Dict
+    spans: List = field(default_factory=list)
+
+
+#: callback names the bus will deliver (anything else is a bug)
+CALLBACKS = ("on_query_start", "on_stage_compiled", "on_stage_completed",
+             "on_fault", "on_query_end")
+
+
+class QueryListener:
+    """Subscriber base class — override any subset of the callbacks.
+
+    The SparkListener seat: `session.add_listener(MyListener())`.
+    Callbacks run synchronously on the driver thread (the engine's
+    driver is single-threaded; the reference's async bus exists to
+    decouple executor heartbeats, which have no analog here).
+    """
+
+    def on_query_start(self, event: QueryStartEvent) -> None:
+        pass
+
+    def on_stage_compiled(self, event: StageCompiledEvent) -> None:
+        pass
+
+    def on_stage_completed(self, event: StageCompletedEvent) -> None:
+        pass
+
+    def on_fault(self, event: FaultEvent) -> None:
+        pass
+
+    def on_query_end(self, event: QueryEndEvent) -> None:
+        pass
+
+
+class ListenerBus:
+    """Synchronous delivery to registered listeners, failure-isolated."""
+
+    def __init__(self):
+        self._listeners: List[QueryListener] = []
+        #: callbacks dropped because a listener raised
+        self.dropped = 0
+
+    def register(self, listener: QueryListener) -> None:
+        if listener not in self._listeners:
+            self._listeners.append(listener)
+
+    def unregister(self, listener: QueryListener) -> None:
+        if listener in self._listeners:
+            self._listeners.remove(listener)
+
+    @property
+    def listeners(self) -> List[QueryListener]:
+        return list(self._listeners)
+
+    def post(self, callback: str, event) -> None:
+        assert callback in CALLBACKS, callback
+        for listener in self._listeners:
+            fn = getattr(listener, callback, None)
+            if fn is None:
+                continue
+            try:
+                fn(event)
+            except Exception as e:  # noqa: BLE001 — never fail the query
+                self.dropped += 1
+                warnings.warn(
+                    f"query listener {type(listener).__name__}.{callback} "
+                    f"raised (dropped): {type(e).__name__}: {e}")

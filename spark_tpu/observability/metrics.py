@@ -1,0 +1,210 @@
+"""Process metrics registry with JSONL + Prometheus sinks.
+
+The MetricsSystem/`metrics.properties` analog, sized to this engine:
+one process-level registry of counters/gauges/timers, flushed to
+configured sinks at query end by the metrics listener (sinks.py).
+Sink selection is conf-driven (`spark_tpu.sql.metrics.sink` =
+"jsonl", "prometheus", or both comma-separated;
+`spark_tpu.sql.metrics.dir` is the output directory):
+
+- jsonl: one snapshot line appended per flush to `metrics.jsonl`
+  (replayable next to the event log);
+- prometheus: text exposition format atomically rewritten to
+  `metrics.prom` on every flush — point node_exporter's textfile
+  collector (or any scraper of files) at the directory.
+
+`METRIC_PREFIXES` is the registered namespace for TRACED per-operator
+metrics (`ctx.add_metric` inside compiled stages). Registration is
+enforced twice: `ExecContext.add_metric` rejects unregistered names at
+trace time, and `scripts/metrics_lint.py` statically asserts every
+call site — so history summaries can never silently miss columns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict
+
+# ---------------------------------------------------------------------------
+# Traced-metric name registry (the SQLMetrics naming discipline)
+# ---------------------------------------------------------------------------
+
+#: every ctx.add_metric name must start with one of these. Extending the
+#: engine with a new traced metric means adding its prefix HERE (and a
+#: history/summary consumer), not just emitting it.
+METRIC_PREFIXES = (
+    "rows_",           # per-operator output rows (executor replay wrapper)
+    "join_rows_",      # join true output-row total (AQE capacity channel)
+    "exch_max_",       # exchange max per-(src,dst) bucket count
+    "exch_rows_",      # exchange routed live rows
+    "exch_bytes_",     # exchange routed payload bytes (shuffle volume)
+    "agg_groups",      # aggregate distinct-group counts (+ _<tag> forms)
+    "gen_rows_",       # generate/explode output rows
+    "rtf_tested_",     # runtime-filter probe rows tested
+    "rtf_pruned_",     # runtime-filter probe rows pruned
+    "rtf_build_ms_",   # runtime-filter trace-time build cost
+)
+
+
+def is_registered_metric(name: str) -> bool:
+    return name.startswith(METRIC_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Timer:
+    __slots__ = ("count", "total_s", "min_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.min_s = min(self.min_s, seconds)
+        self.max_s = max(self.max_s, seconds)
+
+
+class MetricsRegistry:
+    """Named counters/gauges/timers, created on first use."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+
+    def _get(self, store, name, cls):
+        with self._lock:
+            m = store.get(name)
+            if m is None:
+                m = store[name] = cls()
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(self._timers, name, Timer)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "timers": {k: {"count": t.count,
+                               "total_s": round(t.total_s, 6),
+                               "min_s": (round(t.min_s, 6)
+                                         if t.count else 0.0),
+                               "max_s": round(t.max_s, 6)}
+                           for k, t in self._timers.items()},
+            }
+
+    # -- sinks --------------------------------------------------------------
+
+    SINK_KEY = "spark_tpu.sql.metrics.sink"
+    DIR_KEY = "spark_tpu.sql.metrics.dir"
+
+    def flush(self, conf) -> None:
+        """Write every configured sink; a sink failing warns, never
+        raises (observability must not fail the query)."""
+        sinks = [s.strip() for s in
+                 str(conf.get(self.SINK_KEY) or "").split(",") if s.strip()]
+        if not sinks:
+            return
+        out_dir = str(conf.get(self.DIR_KEY))
+        snap = self.snapshot()
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            if "jsonl" in sinks:
+                line = json.dumps(dict(snap, ts=time.time()))
+                with open(os.path.join(out_dir, "metrics.jsonl"), "a") as f:
+                    f.write(line + "\n")
+            if "prometheus" in sinks:
+                write_prometheus(os.path.join(out_dir, "metrics.prom"),
+                                 snap)
+        except OSError as e:
+            import warnings
+            warnings.warn(f"metrics sink write failed: {e}")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "spark_tpu_" + _PROM_BAD.sub("_", name)
+
+
+def write_prometheus(path: str, snapshot: Dict) -> None:
+    """Atomic rewrite in Prometheus text exposition format 0.0.4."""
+    lines = []
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        p = _prom_name(name)
+        lines += [f"# TYPE {p} counter", f"{p} {v}"]
+    for name, v in sorted(snapshot.get("gauges", {}).items()):
+        p = _prom_name(name)
+        lines += [f"# TYPE {p} gauge", f"{p} {v}"]
+    for name, t in sorted(snapshot.get("timers", {}).items()):
+        p = _prom_name(name)
+        lines += [f"# TYPE {p}_count counter", f"{p}_count {t['count']}",
+                  f"# TYPE {p}_seconds_total counter",
+                  f"{p}_seconds_total {t['total_s']}"]
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+
+def parse_prometheus(path: str) -> Dict[str, float]:
+    """Scrape-parse a text-exposition file back to {name: value} (used
+    by tests and the preflight smoke to prove the file is readable)."""
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 2:
+                raise ValueError(f"unparseable exposition line: {line!r}")
+            name, value = parts
+            if _PROM_BAD.search(name):
+                raise ValueError(f"invalid metric name: {name!r}")
+            out[name] = float(value)
+    return out

@@ -1,0 +1,104 @@
+"""Per-stage spans with Chrome-trace export.
+
+Each QueryExecution records named spans over its lifecycle phases
+(analysis -> optimize -> plan -> compile -> ingest -> dispatch ->
+AQE-replan -> retry). Spans use `time.perf_counter` internally (cheap,
+monotonic) with a wall-clock anchor captured at recorder creation, so
+export maps to epoch microseconds — the Chrome trace-event "X"
+(complete-event) format, loadable in Perfetto / chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    t0: float            # perf_counter seconds
+    t1: float
+    attrs: Dict = field(default_factory=dict)
+
+    @property
+    def dur_ms(self) -> float:
+        return (self.t1 - self.t0) * 1e3
+
+
+class SpanRecorder:
+    """Bounded span list for one QueryExecution (query_id = trace tid)."""
+
+    def __init__(self, query_id: int, max_spans: int = 1000):
+        self.query_id = query_id
+        self.max_spans = max_spans
+        self.spans: List[Span] = []
+        #: spans dropped past the bound (surfaced so truncation is
+        #: visible, never silent)
+        self.dropped = 0
+        self._anchor_wall = time.time()
+        self._anchor_perf = time.perf_counter()
+
+    def record(self, name: str, t0: float, t1: Optional[float] = None,
+               **attrs) -> None:
+        if len(self.spans) >= self.max_spans:
+            self.dropped += 1
+            return
+        self.spans.append(Span(name, t0, t1 if t1 is not None else t0,
+                               attrs))
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, t0, time.perf_counter(), **attrs)
+
+    def mark(self, name: str, **attrs) -> None:
+        """Zero-duration span (exported as a Chrome instant event)."""
+        t = time.perf_counter()
+        self.record(name, t, t, **attrs)
+
+    def wall(self, t_perf: float) -> float:
+        """Map a perf_counter time onto the epoch clock."""
+        return self._anchor_wall + (t_perf - self._anchor_perf)
+
+    def to_dicts(self) -> List[Dict]:
+        """Event-log form: relative start + duration in milliseconds."""
+        out = []
+        for s in self.spans:
+            d = {"name": s.name,
+                 "t0_ms": round((s.t0 - self._anchor_perf) * 1e3, 3),
+                 "dur_ms": round(s.dur_ms, 3)}
+            if s.attrs:
+                d["attrs"] = s.attrs
+            out.append(d)
+        return out
+
+
+def to_chrome_trace(recorder: SpanRecorder,
+                    pid: Optional[int] = None) -> Dict:
+    """Chrome trace-event JSON ({"traceEvents": [...]}) from a
+    recorder's spans. Zero-duration spans export as instant events
+    (ph "i"), the rest as complete events (ph "X")."""
+    pid = pid if pid is not None else os.getpid()
+    events = []
+    for s in recorder.spans:
+        ts_us = recorder.wall(s.t0) * 1e6
+        ev = {"name": s.name, "cat": "spark_tpu", "pid": pid,
+              "tid": recorder.query_id, "ts": ts_us}
+        dur_us = (s.t1 - s.t0) * 1e6
+        if dur_us <= 0:
+            ev["ph"] = "i"
+            ev["s"] = "t"  # thread-scoped instant
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = dur_us
+        if s.attrs:
+            ev["args"] = {k: v for k, v in s.attrs.items()}
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

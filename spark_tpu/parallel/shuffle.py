@@ -159,7 +159,15 @@ def _exchange_by_target(batch: Batch, tgt, ctx, block: int,
     ctx.add_metric(f"exch_max_{tag}", max_count)
     # total live rows routed (psum'd): max/(rows/n) is the skew factor
     # the adaptive re-planner reads (OptimizeSkewedJoin.scala:56 seat)
-    ctx.add_metric(f"exch_rows_{tag}", jnp.sum(sel.astype(jnp.int64)))
+    live_rows = jnp.sum(sel.astype(jnp.int64))
+    ctx.add_metric(f"exch_rows_{tag}", live_rows)
+    # routed payload volume (rows x static row width incl. validity):
+    # the shuffle-bytes observable the metrics sinks aggregate — ICI
+    # traffic has no block files to weigh, so it's derived in-trace
+    row_width = sum(c.data.dtype.itemsize
+                    + (1 if c.validity is not None else 0)
+                    for c in batch.columns.values())
+    ctx.add_metric(f"exch_bytes_{tag}", live_rows * row_width)
     ctx.add_flag(f"exch_overflow_{tag}", max_count > block)
 
     def send_recv(x, fill=0):

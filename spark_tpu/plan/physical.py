@@ -156,6 +156,16 @@ class ExecContext:
             self.flags[name] = value
 
     def add_metric(self, name: str, value) -> None:
+        # registered prefixes only (observability/metrics.py): an
+        # unregistered name would flow into the event log but silently
+        # miss every history summary — fail at trace time instead.
+        # scripts/metrics_lint.py enforces the same statically.
+        from ..observability.metrics import is_registered_metric
+        if not is_registered_metric(name):
+            raise ValueError(
+                f"unregistered metric name {name!r}: add its prefix to "
+                f"observability.metrics.METRIC_PREFIXES and a history "
+                f"summary consumer")
         self.metrics[name] = value
 
 

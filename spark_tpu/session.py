@@ -26,6 +26,19 @@ class SparkTpuSession:
         from .catalog import Catalog
         self.catalog: Catalog = Catalog(self)
         self._stage_cache: Dict[str, object] = {}
+        # observability spine (observability/): the listener bus every
+        # event-log line / trace file / metrics flush hangs off, the
+        # process metrics registry, XLA stage-cost memo, and the
+        # session-unique event-log identity + query-id sequence
+        from .observability import ListenerBus, MetricsRegistry
+        from .observability.sinks import (install_default_listeners,
+                                          make_app_id)
+        self.listeners = ListenerBus()
+        self.metrics = MetricsRegistry()
+        self.app_id = make_app_id()
+        self._stage_costs: Dict[str, dict] = {}
+        self._query_seq = 0
+        install_default_listeners(self)
         # plan-fingerprint data cache (reference: CacheManager.scala):
         # requested marks fill with materialized Arrow tables on first
         # action; later plans substitute equal subtrees with cached scans
@@ -40,6 +53,23 @@ class SparkTpuSession:
         from .udf import UDFRegistration
         self.udf = UDFRegistration(self)
         SparkTpuSession._active = self
+
+    # -- observability ------------------------------------------------------
+
+    def _next_query_id(self) -> int:
+        self._query_seq += 1
+        return self._query_seq
+
+    def add_listener(self, listener) -> None:
+        """Register a QueryListener on the session bus (the
+        SparkContext.addSparkListener seat)."""
+        self.listeners.register(listener)
+
+    def remove_listener(self, listener) -> None:
+        self.listeners.unregister(listener)
+
+    addListener = add_listener
+    removeListener = remove_listener
 
     # -- data cache ---------------------------------------------------------
 

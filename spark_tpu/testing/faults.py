@@ -168,6 +168,20 @@ def fire(site: str) -> None:
 
 
 @contextlib.contextmanager
+def suppressed():
+    """Temporarily disarm the plan WITHOUT losing its counters. The
+    observability layer's cost-analysis lowering re-traces a stage;
+    trace-time sites (shuffle, join_build, mesh) must count once per
+    REAL compile, so analysis-only traces run under this guard."""
+    global _PLAN
+    plan, _PLAN = _PLAN, None
+    try:
+        yield
+    finally:
+        _PLAN = plan
+
+
+@contextlib.contextmanager
 def inject(conf, spec: str):
     """Scoped injection for tests: set the conf spec with FRESH hit
     counters, restore and disarm on exit. Yields the armed FaultPlan so

@@ -1,0 +1,448 @@
+"""Observability layer: listener bus ordering (incl. under faults),
+span/Chrome-trace validity, XLA cost accounting, metrics sinks,
+event-log hardening + rotation, history replay views, and golden
+parity with every observability conf enabled."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu import history
+from spark_tpu.functions import col
+from spark_tpu.observability import QueryListener
+from spark_tpu.observability.metrics import parse_prometheus
+from spark_tpu.observability.sinks import json_default
+from spark_tpu.testing import faults
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+EVENT_KEY = "spark_tpu.sql.eventLog.dir"
+TRACE_KEY = "spark_tpu.sql.trace.dir"
+SINK_KEY = "spark_tpu.sql.metrics.sink"
+MDIR_KEY = "spark_tpu.sql.metrics.dir"
+MAXB_KEY = "spark_tpu.sql.eventLog.maxBytes"
+COST_KEY = "spark_tpu.sql.observability.xlaCost"
+
+
+class Recorder(QueryListener):
+    """Collects (callback, event) tuples for ordering assertions."""
+
+    def __init__(self):
+        self.calls = []
+
+    def on_query_start(self, e):
+        self.calls.append(("start", e))
+
+    def on_stage_compiled(self, e):
+        self.calls.append(("compiled", e))
+
+    def on_stage_completed(self, e):
+        self.calls.append(("completed", e))
+
+    def on_fault(self, e):
+        self.calls.append(("fault", e))
+
+    def on_query_end(self, e):
+        self.calls.append(("end", e))
+
+    def names(self):
+        return [c[0] for c in self.calls]
+
+
+def _fresh_agg(session, n=777):
+    """A plan unlikely to be stage-cached already (n varies per test)."""
+    return (session.range(n)
+            .group_by((col("id") % 5).alias("k"))
+            .agg(F.sum(col("id")).alias("s")))
+
+
+# -- listener bus ------------------------------------------------------------
+
+def test_listener_callback_ordering(session):
+    rec = Recorder()
+    session.add_listener(rec)
+    try:
+        _fresh_agg(session, 771).to_pandas()
+    finally:
+        session.remove_listener(rec)
+    names = rec.names()
+    assert names[0] == "start" and names[-1] == "end"
+    assert "completed" in names
+    if "compiled" in names:  # cold stage cache: compile precedes run
+        assert names.index("compiled") < names.index("completed")
+    end = rec.calls[-1][1]
+    assert end.status == "ok"
+    assert end.query_id == rec.calls[0][1].query_id
+    assert end.event["metrics"], end.event
+
+
+def test_listener_ordering_under_faults(session):
+    session.conf.set("spark_tpu.execution.backoffMs", 1)
+    rec = Recorder()
+    session.add_listener(rec)
+    try:
+        with faults.inject(session.conf, "stage_run:unavailable:1"):
+            got = _fresh_agg(session, 772).to_pandas()
+    finally:
+        session.remove_listener(rec)
+    assert got["s"].sum() == sum(range(772))
+    names = rec.names()
+    # retry: fault posted between start and end, completion still last
+    assert "fault" in names
+    assert rec.calls[names.index("fault")][1].action == "transient_retry"
+    assert names.index("fault") < names.index("end")
+    assert names[-1] == "end" and rec.calls[-1][1].status == "ok"
+    # the transient retry dropped the compiled entry: a second compile
+    # event lands AFTER the fault
+    compiles = [i for i, n in enumerate(names) if n == "compiled"]
+    assert compiles and compiles[-1] > names.index("fault")
+
+
+def test_listener_failure_isolated(session):
+    class Bad(QueryListener):
+        def on_query_end(self, e):
+            raise RuntimeError("listener bug")
+
+    bad = Bad()
+    session.add_listener(bad)
+    try:
+        with pytest.warns(UserWarning, match="listener bug"):
+            out = session.range(50).to_pandas()
+    finally:
+        session.remove_listener(bad)
+    assert len(out) == 50
+    assert session.listeners.dropped >= 1
+
+
+def test_failed_query_posts_error_end(session):
+    rec = Recorder()
+    session.add_listener(rec)
+    try:
+        with faults.inject(session.conf, "stage_run:fatal:1"):
+            with pytest.raises(Exception, match="INTERNAL"):
+                _fresh_agg(session, 773).to_pandas()
+    finally:
+        session.remove_listener(rec)
+    assert rec.names()[-1] == "end"
+    end = rec.calls[-1][1]
+    assert end.status == "error"
+    assert "INTERNAL" in end.event["error"]
+
+
+# -- spans / chrome trace ----------------------------------------------------
+
+def test_chrome_trace_valid(session, tmp_path):
+    trace_dir = str(tmp_path / "traces")
+    session.conf.set(TRACE_KEY, trace_dir)
+    try:
+        _fresh_agg(session, 774).to_pandas()
+    finally:
+        session.conf.set(TRACE_KEY, "")
+    files = [f for f in os.listdir(trace_dir)
+             if f.endswith(".trace.json")]
+    assert files, os.listdir(trace_dir)
+    with open(os.path.join(trace_dir, files[-1])) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert events
+    names = {e["name"] for e in events}
+    # the lifecycle phases are all present as spans
+    assert {"analysis", "optimize", "plan", "ingest",
+            "dispatch"} <= names, names
+    for e in events:
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], (int, float))
+        assert e["tid"] >= 1  # query id
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+
+
+def test_spans_in_event_log(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    try:
+        _fresh_agg(session, 775).to_pandas()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    events = history.read_event_log(log_dir)
+    spans = history.stage_summary(events)
+    assert {"analysis", "dispatch"} <= set(spans["span"])
+    assert (spans["dur_ms"] >= 0).all()
+
+
+# -- XLA cost accounting -----------------------------------------------------
+
+def test_stage_cost_captured_in_event_log(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    try:
+        qe = _fresh_agg(session, 776)._qe()
+        qe.execute_batch()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    assert qe.stage_costs, "cost capture should be on with eventLog set"
+    info = next(iter(qe.stage_costs.values()))
+    assert info.get("flops", 0) > 0
+    assert info.get("peak_hbm_bytes", 0) > 0
+    events = history.read_event_log(log_dir)
+    comp = history.compile_summary(events)
+    assert len(comp) >= 1 and comp["flops"].notna().any()
+    hbm = history.hbm_summary(events)
+    assert len(hbm) >= 1
+    assert hbm.iloc[-1]["peak_hbm_bytes"] > 0
+    # runtime explain surfaces the same accounting
+    text = qe.explain(runtime=True)
+    assert "Stage cost (XLA)" in text and "peak HBM" in text
+
+
+def test_cost_capture_off_by_default(session):
+    qe = _fresh_agg(session, 778)._qe()
+    qe.execute_batch()
+    assert not qe.stage_costs  # no observability output configured
+
+
+def test_oom_diagnostic_cites_measured_hbm(session, tmp_path):
+    from spark_tpu.execution.failures import StageOOMError
+    session.conf.set("spark_tpu.execution.backoffMs", 1)
+    session.conf.set(EVENT_KEY, str(tmp_path / "ev"))
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject(session.conf,
+                               "stage_run:resource_exhausted:1,"
+                               "stage_run:resource_exhausted:2,"
+                               "stage_run:resource_exhausted:3"):
+                with pytest.raises(StageOOMError) as exc:
+                    _fresh_agg(session, 779).to_pandas()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    msg = str(exc.value)
+    assert "measured peak HBM demand" in msg, msg
+    assert "temps=" in msg
+
+
+# -- metrics registry + sinks ------------------------------------------------
+
+def test_prometheus_sink_scrape_parses(session, tmp_path):
+    mdir = str(tmp_path / "metrics")
+    session.conf.set(SINK_KEY, "prometheus")
+    session.conf.set(MDIR_KEY, mdir)
+    try:
+        _fresh_agg(session, 780).to_pandas()
+    finally:
+        session.conf.set(SINK_KEY, "")
+    prom = parse_prometheus(os.path.join(mdir, "metrics.prom"))
+    assert prom["spark_tpu_queries_total"] >= 1
+    assert "spark_tpu_query_execution_count" in prom
+    assert any(k.startswith("spark_tpu_compile_cache_") for k in prom)
+    assert any(k.startswith("spark_tpu_device_cache_") for k in prom)
+
+
+def test_jsonl_sink_appends_snapshots(session, tmp_path):
+    mdir = str(tmp_path / "metrics")
+    session.conf.set(SINK_KEY, "jsonl")
+    session.conf.set(MDIR_KEY, mdir)
+    try:
+        _fresh_agg(session, 781).to_pandas()
+        _fresh_agg(session, 782).to_pandas()
+    finally:
+        session.conf.set(SINK_KEY, "")
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(mdir, "metrics.jsonl"))]
+    assert len(lines) >= 2
+    assert lines[-1]["counters"]["queries_total"] \
+        > lines[0]["counters"]["queries_total"] - 1
+    assert "ts" in lines[-1]
+
+
+def test_sink_validator_rejects_unknown(session):
+    with pytest.raises(ValueError):
+        session.conf.set(SINK_KEY, "statsd")
+
+
+def test_metrics_lint_clean():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(root, "scripts", "metrics_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.run() == []
+
+
+def test_unregistered_metric_name_rejected(session):
+    from spark_tpu.config import Conf
+    from spark_tpu.plan.physical import ExecContext
+    ctx = ExecContext(Conf())
+    with pytest.raises(ValueError, match="unregistered metric"):
+        ctx.add_metric("made_up_metric", 1)
+    ctx.add_metric("rows_op1", 1)  # registered prefix passes
+
+
+# -- event-log hardening + rotation ------------------------------------------
+
+def test_json_default_encoder():
+    import jax.numpy as jnp
+    assert json_default(np.int64(7)) == 7
+    assert json_default(np.float32(0.5)) == 0.5
+    assert json_default(np.array([1, 2])) == [1, 2]
+    assert json_default(jnp.asarray(3)) == 3
+    assert json_default({"b", "a"}) == ["a", "b"]
+    # end-to-end: numpy scalars inside an event dict serialize
+    s = json.dumps({"v": np.int64(5), "w": np.float64(1.5)},
+                   default=json_default)
+    assert json.loads(s) == {"v": 5, "w": 1.5}
+
+
+def test_event_log_schema_and_unique_filename(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    try:
+        _fresh_agg(session, 783).to_pandas()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    files = os.listdir(log_dir)
+    assert len(files) == 1
+    # session-unique name: app-<pid>-<token>.jsonl, not bare pid
+    assert files[0] == f"app-{session.app_id}.jsonl"
+    assert files[0] != f"app-{os.getpid()}.jsonl"
+    line = json.loads(open(os.path.join(log_dir, files[0])).read()
+                      .splitlines()[-1])
+    assert line["schema_version"] == 2
+    assert line["status"] == "ok"
+    assert line["query_id"] >= 1
+
+
+def test_event_log_rotation_and_replay_order(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    session.conf.set(MAXB_KEY, 1)  # every write rolls the previous file
+    session.conf.set(COST_KEY, "off")  # keep lines small + fast
+    try:
+        for i in range(4):
+            session.range(100 + i).agg(
+                F.sum(col("id")).alias("s")).to_pandas()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+        session.conf.set(MAXB_KEY, 0)
+        session.conf.set(COST_KEY, "auto")
+    names = sorted(os.listdir(log_dir))
+    rolled = [n for n in names if n.count(".") == 2]
+    assert len(rolled) == 3, names  # 4 writes -> 3 rolls + live file
+    events = history.read_event_log(log_dir)
+    assert len(events) == 4
+    # replay order == write order (rolled files first, in N order)
+    assert events["ts"].is_monotonic_increasing
+    # per-app filter sees rolled files too
+    assert len(history.read_event_log(log_dir, app=session.app_id)) == 4
+
+
+def test_event_log_write_failure_warns_not_raises(session, tmp_path):
+    bad = tmp_path / "afile"
+    bad.write_text("x")
+    session.conf.set(EVENT_KEY, str(bad))
+    try:
+        with pytest.warns(UserWarning, match="event log write failed"):
+            out = session.range(5).to_pandas()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    assert len(out) == 5
+
+
+# -- runtime tree annotations ------------------------------------------------
+
+def test_runtime_tree_join_annotations(session):
+    left = pd.DataFrame({"k": np.arange(50, dtype=np.int64),
+                         "v": np.arange(50, dtype=np.int64)})
+    right = pd.DataFrame({"k": np.arange(0, 50, 5, dtype=np.int64),
+                          "w": np.arange(10, dtype=np.int64)})
+    session.register_table("obs_l", left)
+    session.register_table("obs_r", right)
+    df = session.table("obs_l").join(session.table("obs_r"), on="k")
+    qe = df._qe()
+    qe.execute_batch()
+    text = qe.explain(runtime=True)
+    assert "join rows: 10" in text, text
+    assert "cap" in text  # capacity rides along with the actual
+
+
+# -- history: compare_runs ---------------------------------------------------
+
+def _synthetic_events(tmp_path, name, execution_s):
+    log_dir = tmp_path / name
+    log_dir.mkdir()
+    lines = [{"schema_version": 2, "query_id": i + 1, "ts": 100.0 + i,
+              "status": "ok", "plan": "(AggExec (ScanExec t))",
+              "phase_times_s": {"execution": execution_s},
+              "metrics": {"rows_op1": 1000 * (i + 1)},
+              "stages": [{"key_hash": "abc", "flops": 5000,
+                          "peak_hbm_bytes": 4096,
+                          "argument_bytes": 2048, "temp_bytes": 1024,
+                          "output_bytes": 1024}]}
+             for i in range(2)]
+    with open(log_dir / "app-1-synthetic.jsonl", "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    return str(log_dir)
+
+
+def test_hbm_summary_on_synthetic_log(tmp_path):
+    events = history.read_event_log(
+        _synthetic_events(tmp_path, "a", 0.5))
+    hbm = history.hbm_summary(events)
+    assert len(hbm) == 2
+    row = hbm.iloc[0]
+    assert row["peak_hbm_bytes"] == 4096
+    assert row["peak_stage"] == "abc"
+    assert row["capacity_bytes"] is None  # CPU logs no capacity
+
+
+def test_compare_runs_on_synthetic_logs(tmp_path):
+    base = history.read_event_log(_synthetic_events(tmp_path, "a", 2.0))
+    other = history.read_event_log(_synthetic_events(tmp_path, "b", 1.0))
+    cmp = history.compare_runs(base, other)
+    assert len(cmp) >= 1
+    row = cmp[cmp["column"] == "phase_execution_s"].iloc[0]
+    assert row["base"] == 2.0 and row["other"] == 1.0
+    assert row["delta"] == -1.0 and row["ratio"] == 0.5
+
+
+# -- golden parity with everything on ----------------------------------------
+
+@pytest.fixture(scope="module")
+def obs_tpch_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tpch_obs") / "sf")
+    write_parquet(path, 0.002)
+    return path
+
+
+@pytest.mark.parametrize("qname", ["q1", "q3"])
+def test_golden_parity_all_observability_on(session, obs_tpch_path,
+                                            tmp_path, qname):
+    """Tracing/metrics/cost capture must not perturb results."""
+    Q.register_tables(session, obs_tpch_path)
+    session.conf.set(EVENT_KEY, str(tmp_path / "ev"))
+    session.conf.set(TRACE_KEY, str(tmp_path / "tr"))
+    session.conf.set(SINK_KEY, "jsonl,prometheus")
+    session.conf.set(MDIR_KEY, str(tmp_path / "m"))
+    session.conf.set(COST_KEY, "on")
+    try:
+        got = G.normalize_decimals(
+            Q.QUERIES[qname](session)._qe().collect().to_pandas())
+    finally:
+        session.conf.set(EVENT_KEY, "")
+        session.conf.set(TRACE_KEY, "")
+        session.conf.set(SINK_KEY, "")
+        session.conf.set(COST_KEY, "auto")
+    G.compare(got.reset_index(drop=True),
+              G.GOLDEN[qname](obs_tpch_path))
+    # and all three artifact families exist
+    assert os.listdir(str(tmp_path / "ev"))
+    assert os.listdir(str(tmp_path / "tr"))
+    assert os.path.exists(str(tmp_path / "m" / "metrics.prom"))

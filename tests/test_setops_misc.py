@@ -112,8 +112,8 @@ def test_transient_failure_retries(session, monkeypatch):
     calls = {"n": 0}
     orig = QueryExecution._compile_stage
 
-    def flaky(self, root, mesh=None):
-        fn = orig(self, root, mesh)
+    def flaky(self, root, mesh=None, args=None):
+        fn = orig(self, root, mesh, args)
         def wrapper(*a, **k):
             if calls["n"] == 0:
                 calls["n"] += 1
