@@ -313,6 +313,12 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
                 sum(c.get("bytes_accessed") or 0 for c in costs))
             extra[f"tpch_{name}_sf{sf:g}_peak_hbm_bytes"] = int(max(
                 c.get("peak_hbm_bytes") or 0 for c in costs))
+        # static-analyzer sidecar: findings per query (the BENCH
+        # trajectory must show analyzer noise staying at zero on the
+        # TPC-H suite; a nonzero count is either a real hazard at this
+        # scale factor or an analyzer regression — both reportable)
+        extra[f"tpch_{name}_sf{sf:g}_analysis_findings"] = int(
+            len(qe.analysis_findings or []))
         # runtime-filter observability: fraction of probe rows the
         # injected Bloom/min-max filters pruned before the exchanges
         tested = sum(v for k, v in qe.last_metrics.items()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Unified source-lint runner (spark_tpu/analysis/lints framework).
+
+The generalization of scripts/metrics_lint.py: one registry of AST
+passes over the repository — metric prefixes, conf-key registration,
+fault-site wiring, tracer-leak shapes — run together from preflight
+stage 6 and tests/test_analysis.py.
+
+Usage:
+    scripts/lint.py --all            # every registered pass
+    scripts/lint.py --list           # show the pass catalog
+    scripts/lint.py conf-key ...     # named subset
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(names=None):
+    """All violations as 'path:line: [pass] message' strings (empty =
+    clean tree)."""
+    sys.path.insert(0, REPO)
+    from spark_tpu.analysis.lints import run_passes
+    return [v.render() for v in run_passes(names)]
+
+
+def main(argv) -> int:
+    sys.path.insert(0, REPO)
+    from spark_tpu.analysis.lints import LINT_PASSES
+    from spark_tpu.analysis.lints import passes as _passes  # noqa: F401
+    args = [a for a in argv if a not in ("--all",)]
+    if "--list" in args:
+        for name in sorted(LINT_PASSES):
+            print(f"{name:14s} {LINT_PASSES[name].doc}")
+        return 0
+    names = args or None
+    problems = run(names)
+    label = ",".join(names) if names else "all passes"
+    if problems:
+        print(f"lint ({label}): FAILED")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print(f"lint ({label}): ok ({len(LINT_PASSES) if not names else len(names)} passes, 0 violations)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

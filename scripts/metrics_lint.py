@@ -2,80 +2,30 @@
 """Static lint: every `ctx.add_metric(...)` name must use a registered
 prefix (observability.metrics.METRIC_PREFIXES).
 
-A traced metric with an unregistered name would flow into the event log
-but silently miss every history summary column — this lint (plus the
-trace-time check in ExecContext.add_metric) makes that a CI failure
-instead. Runs from preflight.sh and tests/test_observability.py.
-
-Rules checked per call site:
-  - first argument is a string literal  -> full name must match
-  - first argument is an f-string       -> the LEADING literal part is
-    the prefix; it must be non-empty and match (a metric name that
-    starts with an interpolation can't be attributed to a registry
-    prefix at all)
-  - anything else (variable, call)      -> flagged: the name can't be
-    statically attributed
+Kept as a thin compatibility wrapper: the pass now lives in the unified
+lint framework (`spark_tpu/analysis/lints`, pass name `metric-prefix`)
+and runs with every other pass via `scripts/lint.py --all` (preflight
+stage 6). `run()` keeps its original contract — a list of
+'path:line: message' strings, empty on a clean tree — for
+tests/test_observability.py and any external caller.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Tuple
+from typing import List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "spark_tpu")
 
 
-def _prefix_of(node: ast.expr):
-    """(kind, literal-or-None) for an add_metric name argument."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return "literal", node.value
-    if isinstance(node, ast.JoinedStr):
-        if node.values and isinstance(node.values[0], ast.Constant) \
-                and isinstance(node.values[0].value, str) \
-                and node.values[0].value:
-            return "fstring", node.values[0].value
-        return "fstring", None
-    return "dynamic", None
-
-
-def lint_file(path: str, prefixes) -> List[Tuple[int, str]]:
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    problems: List[Tuple[int, str]] = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "add_metric"
-                and node.args):
-            continue
-        kind, text = _prefix_of(node.args[0])
-        if text is None:
-            problems.append((node.lineno,
-                             f"metric name not statically attributable "
-                             f"({kind} argument)"))
-        elif not text.startswith(tuple(prefixes)):
-            problems.append((node.lineno,
-                             f"unregistered metric prefix: {text!r}"))
-    return problems
-
-
-def run(root: str = PACKAGE) -> List[str]:
-    """All violations as 'path:line: message' strings (empty = clean)."""
+def run(root: str = None) -> List[str]:
+    """All metric-prefix violations (empty = clean). `root` is ignored
+    (the framework walks the repository); kept for signature compat."""
     sys.path.insert(0, REPO)
-    from spark_tpu.observability.metrics import METRIC_PREFIXES
-    out: List[str] = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            for lineno, msg in lint_file(path, METRIC_PREFIXES):
-                rel = os.path.relpath(path, REPO)
-                out.append(f"{rel}:{lineno}: {msg}")
-    return out
+    from spark_tpu.analysis.lints import run_passes
+    return [f"{v.path}:{v.line}: {v.message}"
+            for v in run_passes(["metric-prefix"])]
 
 
 def main() -> int:

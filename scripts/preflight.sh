@@ -14,14 +14,18 @@
 #   4. chaos smoke: one injected OOM + one injected transient against
 #      TPC-H Q1 with golden parity — the failure-recovery ladder
 #      (executor taxonomy + fault injection) must survive end-to-end
-#   5. observability smoke: TPC-H Q1 with eventLog + trace + Prometheus
-#      sinks on; the event line (spans + XLA cost fields), the Chrome
+#   5. observability + analysis smoke: TPC-H Q1/Q3 with eventLog +
+#      trace + Prometheus sinks on AND the pre-compile static analyzer
+#      explicitly enabled (enabled=true, non-strict); golden parity
+#      must hold, the event line (spans + XLA cost fields), the Chrome
 #      trace JSON and the metrics exposition file must all exist and
-#      parse — the observability layer must never be the thing that
-#      breaks a query
-#   6. metrics lint: every ctx.add_metric name statically matches a
-#      registered prefix (scripts/metrics_lint.py), so history
-#      summaries can't silently miss columns
+#      parse, and the analyzer must report ZERO findings on the TPC-H
+#      plans — observability and analysis must never be the thing that
+#      breaks (or noises up) a query
+#   6. source lint: every registered pass of the unified lint framework
+#      (scripts/lint.py --all — metric prefixes, conf-key
+#      registration, fault-site wiring, tracer-leak shapes; absorbs
+#      the former metrics-lint stage)
 #
 # Usage: scripts/preflight.sh [--fast]
 #   --fast skips the full pytest suite (stages 2-6 still run) for quick
@@ -122,7 +126,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                     qe.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/6: observability smoke --"
+echo "-- stage 5/6: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -140,6 +144,11 @@ spark.conf.set("spark_tpu.sql.eventLog.dir", base + "/events")
 spark.conf.set("spark_tpu.sql.trace.dir", base + "/traces")
 spark.conf.set("spark_tpu.sql.metrics.sink", "jsonl,prometheus")
 spark.conf.set("spark_tpu.sql.metrics.dir", base + "/metrics")
+# pre-compile static analyzer explicitly on (non-strict): Q1/Q3 golden
+# parity must hold end to end and the analyzer must stay at zero
+# findings on the TPC-H plans (noise gate)
+spark.conf.set("spark_tpu.sql.analysis.enabled", "true")
+spark.conf.set("spark_tpu.sql.analysis.strict", "false")
 
 path = base + "/sf"
 write_parquet(path, 0.001)
@@ -147,6 +156,12 @@ Q.register_tables(spark, path)
 qe = Q.QUERIES["q1"](spark)._qe()
 got = G.normalize_decimals(qe.collect().to_pandas())
 G.compare(got.reset_index(drop=True), G.GOLDEN["q1"](path))
+assert qe.analysis_findings == [], qe.analysis_findings
+
+qe3 = Q.QUERIES["q3"](spark)._qe()
+got3 = G.normalize_decimals(qe3.collect().to_pandas())
+G.compare(got3.reset_index(drop=True), G.GOLDEN["q3"](path))
+assert qe3.analysis_findings == [], qe3.analysis_findings
 
 # (a) event line with spans + XLA cost fields
 from spark_tpu import history
@@ -173,7 +188,7 @@ print(json.dumps({"preflight_observability_smoke": "ok",
                   "trace_events": len(t["traceEvents"])}))
 EOF2
 
-echo "-- stage 6/6: metrics lint --"
-env JAX_PLATFORMS=cpu python scripts/metrics_lint.py
+echo "-- stage 6/6: source lint (scripts/lint.py --all) --"
+env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
 echo "== preflight PASSED =="

@@ -1,0 +1,31 @@
+"""Static analysis: pre-compile plan/jaxpr analyzer + source lints.
+
+Spark's blueprint front-loads correctness: Catalyst's analyzer
+validates the plan before any execution and Tungsten's codegen fails
+fast on unsupported shapes. This package is that seat for the XLA
+engine, with two halves:
+
+- **Pre-compile analyzer** (`plan_analyzer` + `jaxpr_analyzer`): after
+  planning and before `_compile_stage`, walk the physical plan (and,
+  gated, the abstractly-evaluated jaxpr) and emit typed `Finding`s —
+  dtype-overflow hazards, host-sync loops, recompile churn, mesh
+  replication, x64 truncation. Findings flow through the listener bus
+  (`on_analysis`) into the event log, render in
+  `explain(analysis=True)`, and are governed by
+  `spark_tpu.sql.analysis.{enabled,strict,jaxpr}` — strict mode raises
+  `AnalysisFindingError` pre-compile on error-severity findings.
+- **Source-lint framework** (`lints/`): a registry of AST passes over
+  the package tree (metric prefixes, conf-key registration, fault-site
+  wiring, tracer-leak shapes), run by `scripts/lint.py --all` in CI —
+  the classes of bug previous rounds found by hand, as static checks.
+"""
+
+from .findings import (AnalysisFindingError, CATEGORIES, FINDING_CODES,
+                       Finding, errors_of)
+from .jaxpr_analyzer import analyze_jaxpr, trace_stage
+from .plan_analyzer import analyze_plan
+
+__all__ = [
+    "AnalysisFindingError", "CATEGORIES", "FINDING_CODES", "Finding",
+    "analyze_jaxpr", "analyze_plan", "errors_of", "trace_stage",
+]

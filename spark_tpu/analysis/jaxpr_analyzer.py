@@ -1,0 +1,114 @@
+"""Jaxpr-level stage analyzer: abstract-eval the stage callable and
+walk the equation graph.
+
+The plan walk (`plan_analyzer`) predicts hazards from tree shape; this
+half *confirms* what the stage actually lowers to, by tracing the same
+callable the executor is about to jit (`jax.make_jaxpr` — abstract
+evaluation only, no XLA compile, no device work) and scanning the
+equations recursively (into pjit/scan/while/cond sub-jaxprs):
+
+- collective primitives: `all_gather` under a mesh is full replication
+  on the wire (the definitive form of the plan walk's
+  MESH_FULL_REPLICATION prediction); `psum`/`pmax` are the stats
+  channel and deliberately not findings.
+- host callbacks (`pure_callback`/`io_callback`/...): every dispatch of
+  the stage blocks on a host transition.
+- int32 reduction accumulators while x64 is off: the silent-wrap shape
+  the dtype-overflow category exists for, visible in the lowered ops.
+
+Tracing costs one extra abstract trace per *unique stage key* — results
+are memoized by the executor next to the XLA cost analyses, and
+gated by `spark_tpu.sql.analysis.jaxpr` ('auto' traces only when an
+observability output is configured or strict mode is on, mirroring the
+xlaCost gate).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from .findings import Finding
+
+#: collective primitive names that materialize full replication
+_GATHER_PRIMS = ("all_gather",)
+
+#: host-callback primitive names across jax versions
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "callback",
+                   "debug_callback")
+
+#: reduction primitives whose out-dtype is the accumulator dtype
+_REDUCE_PRIMS = ("reduce_sum", "cumsum", "scatter-add", "segment_sum")
+
+
+def _iter_eqns(jaxpr) -> Iterator:
+    """Depth-first over every equation, descending into sub-jaxprs
+    (pjit bodies, scan/while/cond branches, shard_map bodies) —
+    duck-typed on `.eqns`/`.jaxpr`, so no jax.core version coupling."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    inner = getattr(v, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        yield inner
+        return
+    if hasattr(v, "eqns"):
+        yield v
+        return
+    if isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _sub_jaxprs(item)
+
+
+def trace_stage(fn, args):
+    """Abstract-eval `fn(*args)` to a closed jaxpr (no compile). Raises
+    whatever tracing raises — callers isolate."""
+    import jax
+    return jax.make_jaxpr(fn)(*args)
+
+
+def analyze_jaxpr(closed_jaxpr, mesh_n: int = 1) -> List[Finding]:
+    import jax
+    import numpy as np
+    x64 = bool(jax.config.jax_enable_x64)
+    gathers = 0
+    callbacks = set()
+    i32_accums = 0
+    for eqn in _iter_eqns(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name in _GATHER_PRIMS:
+            gathers += 1
+        elif name in _CALLBACK_PRIMS:
+            callbacks.add(name)
+        elif not x64 and name in _REDUCE_PRIMS:
+            for out in eqn.outvars:
+                dt = getattr(getattr(out, "aval", None), "dtype", None)
+                if dt is not None and np.dtype(dt) == np.dtype(np.int32):
+                    i32_accums += 1
+                    break
+    out: List[Finding] = []
+    if gathers and mesh_n > 1:
+        out.append(Finding(
+            "JAXPR_ALL_GATHER",
+            f"stage lowers to {gathers} all_gather collective(s) across "
+            f"the {mesh_n}-shard mesh: full replication confirmed in "
+            f"the traced program",
+            detail={"all_gather_eqns": gathers, "mesh_n": mesh_n}))
+    if callbacks:
+        out.append(Finding(
+            "JAXPR_HOST_CALLBACK",
+            f"stage contains host callback primitive(s) "
+            f"{sorted(callbacks)}: every dispatch blocks on a "
+            f"device->host transition",
+            detail={"primitives": sorted(callbacks)}))
+    if i32_accums:
+        out.append(Finding(
+            "JAXPR_I32_ACCUMULATOR",
+            f"{i32_accums} reduction(s) accumulate into int32 with "
+            f"jax_enable_x64 off: sums wrap at 2^31",
+            detail={"reductions": i32_accums}))
+    return out

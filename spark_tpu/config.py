@@ -365,6 +365,36 @@ MAX_SPANS = register(
         "retry loop must not grow the trace unboundedly; the recorder "
         "counts what it drops).")
 
+ANALYSIS_ENABLED = register(
+    "spark_tpu.sql.analysis.enabled", True,
+    doc="Run the pre-compile static analyzer (spark_tpu/analysis/): "
+        "after planning and before stage compile, walk the physical "
+        "plan for dtype-overflow, host-sync, recompile, mesh and x64 "
+        "hazards and emit typed findings (listener bus on_analysis -> "
+        "event log; explain(analysis=True)). The plan walk is a pure "
+        "host-side tree traversal (microseconds); findings never "
+        "change results.")
+
+ANALYSIS_STRICT = register(
+    "spark_tpu.sql.analysis.strict", False,
+    doc="Fail fast on analysis: raise a structured AnalysisFindingError "
+        "BEFORE compiling/dispatching any stage when the analyzer "
+        "produced error-severity findings (accumulator overflow, x64 "
+        "truncation) — the CheckAnalysis seat. Warn/info findings "
+        "never raise.")
+
+ANALYSIS_JAXPR = register(
+    "spark_tpu.sql.analysis.jaxpr", "auto",
+    doc="Jaxpr half of the analyzer: abstractly evaluate the stage "
+        "callable (jax.make_jaxpr, no XLA compile) and scan the "
+        "equation graph for all_gather replication, host callbacks and "
+        "int32 accumulators. Costs one extra trace per unique stage "
+        "key (memoized): 'auto' traces only when an observability "
+        "output is configured (eventLog.dir / trace.dir / "
+        "metrics.sink) or analysis.strict is on; 'on' always; 'off' "
+        "never.",
+    validator=lambda v: v in ("auto", "on", "off"))
+
 CHECKPOINT_DIR = register(
     "spark_tpu.sql.checkpoint.dir", "",
     doc="Directory for df.checkpoint(): when set, checkpoints write "

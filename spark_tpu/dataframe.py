@@ -227,13 +227,21 @@ class DataFrame:
     def columns(self) -> List[str]:
         return self.plan.schema().names
 
-    def explain(self, extended: bool = False, runtime: bool = False) -> None:
+    def explain(self, extended: bool = False, runtime: bool = False,
+                analysis: bool = False) -> None:
         """Print the plan. runtime=True re-executes and annotates each
-        operator with its output row count (SQLMetrics analog)."""
+        operator with its output row count (SQLMetrics analog);
+        analysis=True appends the pre-compile static analyzer's
+        findings (spark_tpu/analysis/) — plan-level without executing.
+        Combined with runtime=True, jaxpr-level findings ride along
+        when the jaxpr half ran for that execution: always under
+        `spark_tpu.sql.analysis.jaxpr=on`; under the default `auto`
+        only when an observability output is configured or strict mode
+        is set."""
         qe = self._qe()
         if runtime:
             qe.execute_batch()
-        print(qe.explain(extended, runtime=runtime))
+        print(qe.explain(extended, runtime=runtime, analysis=analysis))
 
     # -- actions ------------------------------------------------------------
 

@@ -67,6 +67,11 @@ class QueryExecution:
         self._last_stage_key: Optional[str] = None
         self.fault_summary: Dict[str, object] = {}
         self.fault_events: list = []
+        # pre-compile static analysis (spark_tpu/analysis/): typed
+        # findings from the plan walk + (gated) jaxpr walk; None until
+        # the analyzer ran for this execution
+        self.analysis_findings: Optional[list] = None
+        self._analysis_posted = False
 
     @property
     def _conf(self):
@@ -186,7 +191,8 @@ class QueryExecution:
             self.spans.record("plan", t0, t1)
         return self._executed
 
-    def explain(self, extended: bool = False, runtime: bool = False) -> str:
+    def explain(self, extended: bool = False, runtime: bool = False,
+                analysis: bool = False) -> str:
         out = []
         if extended:
             out += ["== Logical Plan ==", self.logical.tree_string(),
@@ -210,6 +216,22 @@ class QueryExecution:
         else:
             out += ["== Physical Plan ==",
                     self.executed_plan.tree_string()]
+        if analysis:
+            out.append("== Static Analysis ==")
+            findings = self.analysis_findings
+            if findings is None:
+                # not executed yet: run the (pure, host-side) plan walk
+                # on demand — the jaxpr half needs loaded inputs and
+                # only rides an actual execution
+                from ..analysis import analyze_plan
+                mesh_n = max(1, int(self._conf.get(
+                    "spark_tpu.sql.mesh.size")))
+                findings = analyze_plan(self.executed_plan, self._conf,
+                                        mesh_n)
+            if findings:
+                out += ["  " + f.render() for f in findings]
+            else:
+                out.append("  no findings")
         return "\n".join(out)
 
     def _runtime_tree(self, node: P.PhysicalPlan, depth: int = 0) -> str:
@@ -286,9 +308,11 @@ class QueryExecution:
                 inp._agg_base_schema = node._base_schema()
                 final_groups = [ColumnRef(g.name())
                                 for g in node.group_exprs]
+                from ..columnar import bucket_capacity
                 final = P.HashAggregateExec(
                     inp, final_groups, node.agg_exprs, mode="final",
-                    est_groups=max(partial_table.num_rows, 8))
+                    est_groups=bucket_capacity(
+                        max(partial_table.num_rows, 8)))
                 final.tag = node.tag
                 self.spilled_partial_rows = partial_table.num_rows
                 return final
@@ -400,23 +424,14 @@ class QueryExecution:
             self.stage_costs[key] = info
         return info
 
-    def _compile_stage(self, root: P.PhysicalPlan, mesh=None, args=None):
-        from ..observability.listener import StageCompiledEvent
-        from ..testing import faults
+    def _build_stage_fn(self, root: P.PhysicalPlan, mesh=None):
+        """Construct the stage callable (pre-jit): the replay of the
+        operator tree over input batches, shard_map-wrapped under a
+        mesh. One builder serves both consumers — `_compile_stage` jits
+        exactly this, and the jaxpr analyzer abstractly evaluates
+        exactly this — so the analysis can never drift from the
+        compiled program."""
         conf = self._conf
-        key = self._stage_key(root, mesh)
-        self._last_stage_key = key  # recovery evicts exactly this entry
-        fn = self.session._stage_cache.get(key)
-        if fn is not None:
-            self.session.metrics.counter("compile_cache_hits").inc()
-            self._capture_stage_cost(fn, key, args)
-            self._last_compile_was_miss = False
-            return fn
-        self.session.metrics.counter("compile_cache_misses").inc()
-        self._last_compile_was_miss = True
-        t_compile = time.perf_counter()
-        faults.fire("stage_compile")  # chaos seam: pre-jit, cache miss
-
         per_op = bool(conf.get("spark_tpu.sql.metrics.enabled"))
 
         def replay_root(ctx, inputs):
@@ -446,9 +461,8 @@ class QueryExecution:
                 out = replay_root(ctx, inputs)
                 return out, ctx.flags, ctx.metrics
 
-            fn = jax.jit(run)
+            return run
         else:
-            faults.fire("mesh")  # chaos seam: mesh/shard_map lowering
             from jax.sharding import PartitionSpec as Psp
             from ..parallel.mesh import shard_map
             from ..parallel import stripe_batch
@@ -484,11 +498,30 @@ class QueryExecution:
                     metrics[k] = red(jnp.asarray(v), AXIS)
                 return out, flags, metrics
 
-            fn = jax.jit(shard_map(
+            return shard_map(
                 run_shard, mesh=mesh,
                 in_specs=(Psp(AXIS), Psp(AXIS)),
                 out_specs=(Psp(AXIS), Psp(), Psp()),
-                check_vma=False))
+                check_vma=False)
+
+    def _compile_stage(self, root: P.PhysicalPlan, mesh=None, args=None):
+        from ..observability.listener import StageCompiledEvent
+        from ..testing import faults
+        key = self._stage_key(root, mesh)
+        self._last_stage_key = key  # recovery evicts exactly this entry
+        fn = self.session._stage_cache.get(key)
+        if fn is not None:
+            self.session.metrics.counter("compile_cache_hits").inc()
+            self._capture_stage_cost(fn, key, args)
+            self._last_compile_was_miss = False
+            return fn
+        self.session.metrics.counter("compile_cache_misses").inc()
+        self._last_compile_was_miss = True
+        t_compile = time.perf_counter()
+        faults.fire("stage_compile")  # chaos seam: pre-jit, cache miss
+        if mesh is not None:
+            faults.fire("mesh")  # chaos seam: mesh/shard_map lowering
+        fn = jax.jit(self._build_stage_fn(root, mesh))
         self.session._stage_cache[key] = fn
         cost = self._capture_stage_cost(fn, key, args)
         t1 = time.perf_counter()
@@ -509,6 +542,103 @@ class QueryExecution:
                     mesh_n=int(mesh.devices.size) if mesh is not None else 1,
                     cost=cost))
         return fn
+
+    # -- pre-compile static analysis (spark_tpu/analysis/) ------------------
+
+    def _analysis_conf(self):
+        conf = self._conf
+        return (bool(conf.get("spark_tpu.sql.analysis.enabled")),
+                bool(conf.get("spark_tpu.sql.analysis.strict")))
+
+    def _jaxpr_analysis_on(self, strict: bool) -> bool:
+        """Gate for the jaxpr half (one extra abstract trace per unique
+        stage key, memoized): mirrors the xlaCost 'auto' discipline."""
+        mode = str(self._conf.get("spark_tpu.sql.analysis.jaxpr"))
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        return strict or self._events_enabled()
+
+    def _post_analysis(self, strict: bool) -> None:
+        """Publish findings on the bus (once per execution) and raise
+        pre-compile under strict when any is error-severity."""
+        from ..analysis import AnalysisFindingError, errors_of
+        from ..observability.listener import AnalysisEvent
+        findings = self.analysis_findings or []
+        if findings and self._observe_events and not self._analysis_posted:
+            self._analysis_posted = True
+            self.session.listeners.post("on_analysis", AnalysisEvent(
+                query_id=self.query_id, ts=time.time(),
+                findings=[f.to_dict() for f in findings]))
+        if strict and errors_of(findings):
+            raise AnalysisFindingError(findings)
+
+    def _analyze_plan_phase(self) -> None:
+        """Plan-level walk of the planned tree — BEFORE streaming
+        splices/UDF extraction execute anything, so strict mode rejects
+        a hazardous plan with zero device work done."""
+        enabled, strict = self._analysis_conf()
+        if not enabled:
+            # leave None ("never analyzed"), NOT [] ("analyzed clean"):
+            # explain(analysis=True) runs its on-demand walk off the
+            # None sentinel, so a disabled execution can't print a
+            # false clean bill
+            self.analysis_findings = None
+            return
+        from ..analysis import analyze_plan
+        t0 = time.perf_counter()
+        mesh_n = max(1, int(self._conf.get("spark_tpu.sql.mesh.size")))
+        self.analysis_findings = analyze_plan(self.executed_plan,
+                                              self._conf, mesh_n)
+        self.spans.record("analyze", t0, time.perf_counter(),
+                          findings=len(self.analysis_findings))
+        if strict:
+            self._post_analysis(strict)
+
+    def _analyze_jaxpr_phase(self, root: P.PhysicalPlan, mesh,
+                             args) -> None:
+        """Jaxpr-level walk of the exact callable about to be jitted,
+        memoized per stage key next to the XLA cost analyses. Appends to
+        the plan-phase findings, then publishes the combined set."""
+        enabled, strict = self._analysis_conf()
+        if not enabled:
+            return
+        if self._jaxpr_analysis_on(strict):
+            from ..analysis import analyze_jaxpr, trace_stage
+            from ..testing import faults
+            key = "jaxpr#" + self._stage_key(root, mesh)
+            memo = self.session._analysis_memo
+            found = memo.get(key)
+            if found is None:
+                t0 = time.perf_counter()
+                try:
+                    # suppressed(): abstract evaluation re-traces the
+                    # stage; trace-time chaos sites must count once per
+                    # REAL compile only
+                    with faults.suppressed():
+                        jaxpr = trace_stage(
+                            self._build_stage_fn(root, mesh), args)
+                    n = int(mesh.devices.size) if mesh is not None else 1
+                    found = analyze_jaxpr(jaxpr, mesh_n=n)
+                except Exception as e:  # noqa: BLE001 — advisory only
+                    import warnings
+                    warnings.warn(f"jaxpr analysis failed (skipped): "
+                                  f"{type(e).__name__}: {e}")
+                    found = []
+                else:
+                    memo[key] = found
+                    while len(memo) > 512:
+                        memo.pop(next(iter(memo)))
+                self.spans.record("analyze_jaxpr", t0,
+                                  time.perf_counter(),
+                                  findings=len(found))
+            if found:
+                known = {(f.code, f.op) for f in
+                         (self.analysis_findings or [])}
+                self.analysis_findings = (self.analysis_findings or []) \
+                    + [f for f in found if (f.code, f.op) not in known]
+        self._post_analysis(strict)
 
     def _aqe_cache_key(self, mesh) -> Optional[str]:
         """Plan + data-identity key for persisted AQE capacities; None
@@ -606,6 +736,11 @@ class QueryExecution:
         conf = self._conf
         self.fault_summary = {}
         self.fault_events = []
+        # NOTE: _analysis_posted is NOT reset here — it is
+        # per-QueryExecution, so an external-collect attempt that falls
+        # through to execute_batch (or a re-executed qe) posts the
+        # on_analysis event exactly once
+        self.analysis_findings = None
         self._oom_rung = 0
         self._retry_policy = RetryPolicy(
             max_retries=self._max_retries(conf),
@@ -842,6 +977,11 @@ class QueryExecution:
             if aqe_key is not None else None
         if saved_caps:
             self._apply_saved_caps(self.executed_plan, saved_caps)
+        # static analysis, plan half: after planning (with persisted AQE
+        # caps applied — they are part of the stage key the recompile
+        # check audits), before any streaming splice or compile. Strict
+        # mode raises here, pre-compile.
+        self._analyze_plan_phase()
         root0 = self.executed_plan
         from .python_eval import extract_python_udfs, plan_has_udfs
         if plan_has_udfs(root0):
@@ -885,6 +1025,12 @@ class QueryExecution:
         token = None
         if mesh is not None:
             token = jnp.zeros((int(mesh.devices.size),), jnp.int32)
+        # static analysis, jaxpr half: abstract-eval the exact stage
+        # callable about to be jitted (gated; memoized per stage key),
+        # then publish the combined findings on the bus
+        self._analyze_jaxpr_phase(
+            root, mesh,
+            (scan_batches,) if mesh is None else (scan_batches, token))
         adaptive = bool(self._conf.get("spark_tpu.sql.adaptive.enabled"))
         profile_dir = str(self._conf.get("spark_tpu.sql.profile.dir"))
         import contextlib
@@ -966,7 +1112,11 @@ class QueryExecution:
                     else:
                         tag = k[len("agg_overflow_"):]
                         total = int(metrics[f"agg_groups_{tag}"])
-                        self._set_agg_groups(root, tag, max(total, 8))
+                        # bucketed like every other learned capacity:
+                        # compute re-buckets before use, and a raw count
+                        # in the stage key recompiles per exact total
+                        self._set_agg_groups(root, tag,
+                                             bucket_capacity(max(total, 8)))
             else:
                 raise RuntimeError(
                     f"capacity retries did not converge; still "
@@ -1160,6 +1310,11 @@ class QueryExecution:
             cap = xla_cost.device_hbm_capacity()
             if cap is not None:
                 event["device_hbm_capacity_bytes"] = cap
+        if self.analysis_findings:
+            # pre-compile analyzer findings (read back via
+            # history.read_event_log; bench counts them per query)
+            event["analysis_findings"] = [
+                f.to_dict() for f in self.analysis_findings]
         if self.fault_summary:
             # every retry/eviction/degradation/fallback this
             # execution survived (history.fault_summary reads these)
@@ -1213,6 +1368,12 @@ class QueryExecution:
         self._activate_conf()
         if plan_has_udfs(self.executed_plan):
             return None  # UDF stages evaluate through execute_batch
+        # the out-of-core egress path never reaches execute_batch, but
+        # it is exactly where the host-spill findings live — analyze
+        # (and strict-gate) here too
+        self._observe_events = self._events_enabled()
+        self._analyze_plan_phase()
+        self._post_analysis(self._analysis_conf()[1])
         t0 = time.perf_counter()
         out = try_external_collect(self.session, self.executed_plan,
                                    self.session.conf,

@@ -26,15 +26,16 @@ the engine-sized analog, organized the same way:
   installs at construction.
 """
 
-from .listener import (FaultEvent, ListenerBus, QueryEndEvent,
-                       QueryListener, QueryStartEvent, StageCompiledEvent,
-                       StageCompletedEvent)
+from .listener import (AnalysisEvent, FaultEvent, ListenerBus,
+                       QueryEndEvent, QueryListener, QueryStartEvent,
+                       StageCompiledEvent, StageCompletedEvent)
 from .metrics import (METRIC_PREFIXES, MetricsRegistry,
                       is_registered_metric)
 from .spans import Span, SpanRecorder, to_chrome_trace
 
 __all__ = [
-    "FaultEvent", "ListenerBus", "MetricsRegistry", "METRIC_PREFIXES",
+    "AnalysisEvent", "FaultEvent", "ListenerBus", "MetricsRegistry",
+    "METRIC_PREFIXES",
     "QueryEndEvent", "QueryListener", "QueryStartEvent", "Span",
     "SpanRecorder", "StageCompiledEvent", "StageCompletedEvent",
     "is_registered_metric", "to_chrome_trace",

@@ -56,6 +56,17 @@ class StageCompletedEvent:
 
 
 @dataclass
+class AnalysisEvent:
+    """Posted once per execution when the pre-compile static analyzer
+    (spark_tpu/analysis/) ran and produced findings. `findings` is the
+    event-log-serializable dict form (Finding.to_dict)."""
+
+    query_id: int
+    ts: float
+    findings: List[Dict] = field(default_factory=list)
+
+
+@dataclass
 class FaultEvent:
     """Posted for every recovery action the failure ladder takes
     (transient retry, stage timeout, OOM rung, mesh fallback)."""
@@ -81,8 +92,8 @@ class QueryEndEvent:
 
 
 #: callback names the bus will deliver (anything else is a bug)
-CALLBACKS = ("on_query_start", "on_stage_compiled", "on_stage_completed",
-             "on_fault", "on_query_end")
+CALLBACKS = ("on_query_start", "on_analysis", "on_stage_compiled",
+             "on_stage_completed", "on_fault", "on_query_end")
 
 
 class QueryListener:
@@ -95,6 +106,9 @@ class QueryListener:
     """
 
     def on_query_start(self, event: QueryStartEvent) -> None:
+        pass
+
+    def on_analysis(self, event: AnalysisEvent) -> None:
         pass
 
     def on_stage_compiled(self, event: StageCompiledEvent) -> None:

@@ -156,7 +156,13 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
         est = int(conf.get("spark_tpu.sql.aggregate.estimatedGroups"))
         rows = estimate_rows(plan.child)
         if rows is not None:
-            est = min(est, max(1, rows))
+            # bucket the estimate: it lands verbatim in the stage-cache
+            # key (simple_string), so a raw row count would recompile
+            # per exact input size (analysis UNBUCKETED_CAPACITY);
+            # compute buckets it before use anyway, so output shapes
+            # are unchanged
+            from ..columnar import bucket_capacity
+            est = min(est, bucket_capacity(max(1, rows)))
         positional = any(getattr(a.func, "positional", False)
                          for a in plan.agg_exprs)
         if n <= 1 or positional:

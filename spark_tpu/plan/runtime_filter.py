@@ -230,8 +230,15 @@ def _try_inject(join: P.JoinExec, threshold: int, fpp: float
             continue
         if not _resolves(pk, target.schema()):
             continue
+        # bucketed: est_items sits verbatim in simple_string and hence
+        # the stage-cache key; a raw scan row count would recompile the
+        # stage per exact input size (analysis UNBUCKETED_CAPACITY).
+        # Bloom sizing only rounds UP — false-positive rate can only
+        # improve, results are unchanged by construction.
+        from ..columnar import bucket_capacity
         rf = P.RuntimeFilterExec(target, creation, pk, build_key,
-                                 est_items=max(int(rows), 8), fpp=fpp)
+                                 est_items=bucket_capacity(max(int(rows), 8)),
+                                 fpp=fpp)
         new_join = copy.copy(join)
         if isinstance(probe, P.ExchangeExec):
             new_ex = copy.copy(probe)

@@ -37,6 +37,8 @@ class SparkTpuSession:
         self.metrics = MetricsRegistry()
         self.app_id = make_app_id()
         self._stage_costs: Dict[str, dict] = {}
+        # memoized jaxpr-analysis findings per stage key (analysis/)
+        self._analysis_memo: Dict[str, list] = {}
         self._query_seq = 0
         install_default_listeners(self)
         # plan-fingerprint data cache (reference: CacheManager.scala):

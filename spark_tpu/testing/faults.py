@@ -14,7 +14,7 @@ Grammar (comma-separated rules):
 
     rule  := site ":" fault ":" nth [":" arg]
     site  := scan_load | stage_compile | stage_run | shuffle
-             | join_build | mesh   (any string; these are the built-ins)
+             | join_build | mesh   (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
     arg   := fault argument (only `slow`: sleep milliseconds, default 100)
@@ -46,6 +46,46 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 INJECT_KEY = "spark_tpu.faults.inject"
+
+#: the wired-seam registry: every site here has a `faults.fire(site)`
+#: call planted in the engine (the `fault-site` lint pass proves both
+#: directions statically). `_parse` validates rule sites against this
+#: set at ARM time — a typo'd site (`stage_rnu`) used to parse fine and
+#: then silently never fire, so the chaos test tested nothing.
+KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
+               "join_build", "mesh")
+
+#: test-registered extra seams (register_site): code under test may
+#: plant its own fire() points without editing the built-in tuple
+_EXTRA_SITES: set = set()
+
+
+def register_site(site: str) -> str:
+    """Declare an ad-hoc injection seam (tests planting their own
+    `faults.fire(site)` points). Returns the site for inline use.
+    Registration is process-global — prefer `scoped_site` in tests so
+    a leaked registration can't quietly re-open the silent-no-fire
+    hole the parse-time site validation closes."""
+    _EXTRA_SITES.add(site)
+    return site
+
+
+def unregister_site(site: str) -> None:
+    _EXTRA_SITES.discard(site)
+
+
+@contextlib.contextmanager
+def scoped_site(site: str):
+    """`register_site` bounded to a with-block (the test idiom)."""
+    register_site(site)
+    try:
+        yield site
+    finally:
+        unregister_site(site)
+
+
+def known_sites() -> tuple:
+    return KNOWN_SITES + tuple(sorted(_EXTRA_SITES))
 
 #: raising fault classes -> message templates shaped like real errors
 _MESSAGES = {
@@ -96,6 +136,11 @@ def _parse(spec: str) -> List[_Rule]:
             raise ValueError(
                 f"bad fault rule {part!r}: want site:fault:nth[:arg]")
         site, fault = bits[0].strip(), bits[1].strip()
+        if site not in known_sites():
+            raise ValueError(
+                f"unknown fault site {site!r} in rule {part!r}: no "
+                f"faults.fire({site!r}) seam is wired, so the rule "
+                f"could never fire; known sites: {known_sites()}")
         if fault not in FAULT_CLASSES:
             raise ValueError(
                 f"unknown fault class {fault!r} in {part!r}; "

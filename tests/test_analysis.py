@@ -1,0 +1,464 @@
+"""Static-analysis suite: the pre-compile plan/jaxpr analyzer
+(spark_tpu/analysis/) and the unified source-lint framework
+(spark_tpu/analysis/lints + scripts/lint.py).
+
+Analyzer contract under test: each finding category fires on a
+seeded-violation plan, strict mode raises BEFORE any compile, TPC-H
+Q1/Q3 goldens are byte-identical with the analyzer on, and the real
+TPC-H plans produce ZERO findings (the noise gate). Framework contract:
+every lint pass catches a synthetic violation and passes on the real
+tree."""
+
+import decimal
+import os
+
+import pyarrow as pa
+import pytest
+
+import jax
+
+from spark_tpu import functions as F
+from spark_tpu import types as T
+from spark_tpu.analysis import (AnalysisFindingError, FINDING_CODES,
+                                Finding, analyze_plan)
+from spark_tpu.functions import col, udf
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+SF = 0.001
+ENABLED_KEY = "spark_tpu.sql.analysis.enabled"
+STRICT_KEY = "spark_tpu.sql.analysis.strict"
+JAXPR_KEY = "spark_tpu.sql.analysis.jaxpr"
+CHUNK_KEY = "spark_tpu.sql.execution.streamingChunkRows"
+MESH_KEY = "spark_tpu.sql.mesh.size"
+
+
+@pytest.fixture(scope="session")
+def tpch_session(session, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tpch_analysis") / "sf")
+    write_parquet(path, SF)
+    Q.register_tables(session, path)
+    session._tpch_analysis_path = path
+    return session
+
+
+def _codes(findings):
+    return [f.code for f in (findings or [])]
+
+
+# -- finding registry ---------------------------------------------------------
+
+def test_finding_codes_closed_registry():
+    with pytest.raises(ValueError, match="unknown finding code"):
+        Finding("MADE_UP", "nope")
+    f = Finding("SUM_I64_OVERFLOW", "msg", op="x")
+    assert f.category == "dtype-overflow" and f.severity == "error"
+    d = f.to_dict()
+    assert d["code"] == "SUM_I64_OVERFLOW" and d["severity"] == "error"
+    # every registered code carries (category, severity, doc)
+    for code, (cat, sev, doc) in FINDING_CODES.items():
+        assert sev in ("error", "warn", "info"), code
+        assert doc, code
+
+
+# -- dtype-overflow -----------------------------------------------------------
+
+def _overflow_plan(session):
+    """int32 sum over a lazily-planned 2^33-row range: 33 rows-bits +
+    31 value-bits > 63 accumulator bits. Never executed — Range is
+    synthesized in-trace, so planning/analysis touch no data."""
+    return (session.range(1 << 33)
+            .select(col("id").cast(T.INT).alias("v"))
+            .agg(F.sum(col("v")).alias("s")))
+
+
+def test_overflow_finding_int32_plan(session):
+    qe = _overflow_plan(session)._qe()
+    findings = analyze_plan(qe.executed_plan, session.conf, 1)
+    hits = [f for f in findings if f.code == "SUM_I64_OVERFLOW"]
+    assert hits, findings
+    assert hits[0].severity == "error"
+    assert hits[0].detail["required_bits"] > hits[0].detail["acc_bits"]
+
+
+def test_overflow_finding_decimal_executes(session):
+    """decimal(18,0): ~60 value bits, 16 rows -> 64 > 63. Execution
+    still succeeds (non-strict): the finding is advisory and lands on
+    the QueryExecution."""
+    vals = [decimal.Decimal(i) for i in range(16)]
+    table = pa.table({"d": pa.array(vals, type=pa.decimal128(18, 0))})
+    session.register_table("ana_dec", table)
+    qe = session.table("ana_dec").agg(F.sum(col("d")).alias("s"))._qe()
+    out = qe.collect()
+    assert out.num_rows == 1
+    assert "SUM_I64_OVERFLOW" in _codes(qe.analysis_findings)
+
+
+def test_no_overflow_on_bounded_sum(session):
+    # pmod bounds the value statically: 16 rows x 2^8 stays tiny
+    qe = (session.range(16)
+          .select(F.pmod(col("id"), 256).alias("k"))
+          .agg(F.sum(col("k")).alias("s")))._qe()
+    qe.collect()
+    assert _codes(qe.analysis_findings) == []
+
+
+def test_strict_raises_before_compile(session):
+    session.conf.set(STRICT_KEY, "true")
+    session._stage_cache.clear()
+    with pytest.raises(AnalysisFindingError) as ei:
+        _overflow_plan(session)._qe().execute_batch()
+    assert "SUM_I64_OVERFLOW" in [f.code for f in ei.value.findings]
+    # pre-compile: nothing was jitted, no device work happened
+    assert session._stage_cache == {}
+
+
+def test_strict_ignores_warn_findings(session):
+    session.conf.set(STRICT_KEY, "true")
+    session.conf.set(CHUNK_KEY, 1 << 10)
+    df = (session.range(1 << 12)
+          .select(F.pmod(col("id"), 64).alias("k"))
+          .group_by(col("k")).agg(F.sum(col("k")).alias("s")))
+    qe = df._qe()
+    out = qe.collect()  # STREAMING_HOST_SYNC is warn-severity: no raise
+    assert out.num_rows == 64
+    assert "STREAMING_HOST_SYNC" in _codes(qe.analysis_findings)
+
+
+# -- host-sync ----------------------------------------------------------------
+
+def test_streaming_host_sync_finding(session):
+    session.conf.set(CHUNK_KEY, 1 << 10)
+    qe = (session.range(1 << 12)
+          .select(F.pmod(col("id"), 64).alias("k"))
+          .group_by(col("k")).agg(F.sum(col("k")).alias("s")))._qe()
+    qe.collect()
+    hits = [f for f in qe.analysis_findings
+            if f.code == "STREAMING_HOST_SYNC"]
+    assert hits and hits[0].detail["chunks"] >= 4
+    assert hits[0].severity == "warn"
+
+
+def test_spill_host_sync_finding_external_path(session, tmp_path):
+    """deviceBudget reroutes collect() through the out-of-core external
+    path, which never reaches execute_batch — the analyzer must still
+    run (and find the spill) there."""
+    import pandas as pd
+    pd.DataFrame({"v": range(4096)}).to_parquet(tmp_path / "t.parquet")
+    df = session.read_parquet(str(tmp_path / "t.parquet"))
+    session.conf.set("spark_tpu.sql.memory.deviceBudget", 1024)
+    try:
+        qe = df._qe()
+        out = qe.collect()
+        assert out.num_rows == 4096
+        assert "SPILL_HOST_SYNC" in _codes(qe.analysis_findings)
+        assert "external" in qe.phase_times  # really took the path
+    finally:
+        session.conf.set("spark_tpu.sql.memory.deviceBudget", 0)
+
+
+def test_no_duplicate_findings_on_dag_shared_scans(tpch_session):
+    """A runtime filter's creation chain shares its scan leaf with the
+    join build side (the tree is a DAG): each shared node must be
+    analyzed once, not once per path — duplicates would inflate the
+    bench sidecar and the event log."""
+    session = tpch_session
+    session.conf.set("spark_tpu.sql.memory.deviceBudget", 1024)
+    try:
+        qe = Q.QUERIES["q3"](session)._qe()
+        findings = analyze_plan(qe.executed_plan, session.conf, 1)
+        spills = [f for f in findings if f.code == "SPILL_HOST_SYNC"]
+        ops = [f.op for f in spills]
+        assert spills and len(ops) == len(set(ops)), ops
+    finally:
+        session.conf.set("spark_tpu.sql.memory.deviceBudget", 0)
+
+
+def test_udf_host_roundtrip_finding(session):
+    import pandas as pd
+    session.register_table("ana_udf", pd.DataFrame({"v": [1.0, 2.0]}))
+    plus = udf(lambda v: v + 1.0, "double")
+    qe = session.table("ana_udf").select(plus(col("v")).alias("w"))._qe()
+    qe.collect()
+    assert "UDF_HOST_ROUNDTRIP" in _codes(qe.analysis_findings)
+
+
+# -- recompile ----------------------------------------------------------------
+
+def test_recompile_clean_on_real_plans(tpch_session):
+    """The shipped planner buckets every capacity it bakes into stage
+    keys — the analyzer (which flags exactly what a raw row count used
+    to cause) must be silent on real TPC-H plans."""
+    for qname in ("q1", "q3"):
+        qe = Q.QUERIES[qname](tpch_session)._qe()
+        findings = analyze_plan(qe.executed_plan, tpch_session.conf, 1)
+        assert [f for f in findings if f.category == "recompile"] == []
+
+
+def test_recompile_finding_seeded(session):
+    import spark_tpu.plan.physical as P
+    qe = (session.range(1 << 12)
+          .select(F.pmod(col("id"), 64).alias("k"))
+          .group_by(col("k")).agg(F.sum(col("k")).alias("s")))._qe()
+    root = qe.executed_plan
+
+    def seed(n):
+        if isinstance(n, P.HashAggregateExec):
+            n.est_groups = 1000  # raw row count, the pre-PR-4 shape
+        for c in n.children:
+            seed(c)
+
+    seed(root)
+    findings = analyze_plan(root, session.conf, 1)
+    hits = [f for f in findings if f.code == "UNBUCKETED_CAPACITY"]
+    assert hits and hits[0].detail == {
+        "kind": "aggregate.est_groups", "value": 1000, "bucketed": 1024}
+
+
+# -- mesh ---------------------------------------------------------------------
+
+def test_mesh_replication_finding(tpch_session):
+    import pandas as pd
+    session = tpch_session
+    session.conf.set(MESH_KEY, 8)
+    try:
+        left = session.create_dataframe(
+            pd.DataFrame({"k": list(range(2000)),
+                          "v": list(range(2000))}), "ana_l")
+        right = session.create_dataframe(
+            pd.DataFrame({"k": list(range(10)),
+                          "n": list(range(10))}), "ana_r")
+        qe = left.join(right, on="k", how="inner")._qe()
+        findings = analyze_plan(qe.executed_plan, session.conf, 8)
+        hits = [f for f in findings
+                if f.code == "MESH_FULL_REPLICATION"]
+        assert hits, findings  # broadcast build side under the mesh
+        assert hits[0].detail["mesh_n"] == 8
+    finally:
+        session.conf.set(MESH_KEY, 0)
+
+
+def test_mesh_jaxpr_all_gather_finding(session):
+    import pandas as pd
+    session.conf.set(MESH_KEY, 8)
+    session.conf.set(JAXPR_KEY, "on")
+    try:
+        left = session.create_dataframe(
+            pd.DataFrame({"k": list(range(160)),
+                          "v": list(range(160))}), "ana_jl")
+        right = session.create_dataframe(
+            pd.DataFrame({"k": list(range(8)),
+                          "n": list(range(8))}), "ana_jr")
+        qe = left.join(right, on="k", how="inner")._qe()
+        out = qe.collect()
+        assert out.num_rows == 8
+        codes = _codes(qe.analysis_findings)
+        assert "JAXPR_ALL_GATHER" in codes, codes
+    finally:
+        session.conf.set(MESH_KEY, 0)
+
+
+# -- x64 ----------------------------------------------------------------------
+
+def test_x64_truncation_finding(session):
+    qe = session.range(128).agg(F.sum(col("id")).alias("s"))._qe()
+    root = qe.executed_plan
+    jax.config.update("jax_enable_x64", False)
+    try:
+        findings = analyze_plan(root, session.conf, 1)
+        hits = [f for f in findings if f.code == "X64_TRUNCATION"]
+        assert hits and hits[0].severity == "error"
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    # x64 back on: same plan, no finding
+    assert "X64_TRUNCATION" not in _codes(
+        analyze_plan(root, session.conf, 1))
+
+
+# -- surfacing: bus, event log, explain --------------------------------------
+
+def test_analysis_event_on_bus(session):
+    from spark_tpu.observability import QueryListener
+
+    class Collect(QueryListener):
+        def __init__(self):
+            self.events = []
+
+        def on_analysis(self, event):
+            self.events.append(event)
+
+    listener = Collect()
+    session.add_listener(listener)
+    session.conf.set(CHUNK_KEY, 1 << 10)
+    try:
+        df = (session.range(1 << 12)
+              .select(F.pmod(col("id"), 64).alias("k"))
+              .group_by(col("k")).agg(F.sum(col("k")).alias("s")))
+        df._qe().collect()
+    finally:
+        session.remove_listener(listener)
+    assert listener.events, "on_analysis never posted"
+    codes = [f["code"] for f in listener.events[-1].findings]
+    assert "STREAMING_HOST_SYNC" in codes
+
+
+def test_analysis_findings_in_event_log(session, tmp_path):
+    import json
+    session.conf.set("spark_tpu.sql.eventLog.dir", str(tmp_path))
+    session.conf.set(CHUNK_KEY, 1 << 10)
+    qe = (session.range(1 << 12)
+          .select(F.pmod(col("id"), 64).alias("k"))
+          .group_by(col("k")).agg(F.sum(col("k")).alias("s")))._qe()
+    qe.collect()
+    lines = []
+    for name in os.listdir(tmp_path):
+        with open(tmp_path / name) as f:
+            lines += [json.loads(l) for l in f if l.strip()]
+    logged = [l for l in lines if l.get("analysis_findings")]
+    assert logged, lines
+    rec = logged[-1]["analysis_findings"][0]
+    assert set(rec) >= {"code", "category", "severity", "message"}
+
+
+def test_explain_analysis_section(session):
+    qe = _overflow_plan(session)._qe()
+    text = qe.explain(analysis=True)
+    assert "== Static Analysis ==" in text
+    assert "SUM_I64_OVERFLOW" in text
+    clean = session.range(8)._qe().explain(analysis=True)
+    assert "no findings" in clean
+
+
+def test_analysis_disabled_leaves_none_and_explain_still_works(session):
+    session.conf.set(ENABLED_KEY, "false")
+    session.conf.set(CHUNK_KEY, 1 << 10)
+    qe = (session.range(1 << 12)
+          .select(F.pmod(col("id"), 64).alias("k"))
+          .group_by(col("k")).agg(F.sum(col("k")).alias("s")))._qe()
+    qe.collect()
+    # None = "never analyzed", distinct from [] = "analyzed clean"
+    assert qe.analysis_findings is None
+    # explain(analysis=True) is an explicit request: the on-demand walk
+    # still runs and reports the hazard the disabled execution skipped
+    assert "STREAMING_HOST_SYNC" in qe.explain(analysis=True)
+
+
+# -- golden parity (acceptance) ----------------------------------------------
+
+@pytest.mark.parametrize("qname", ["q1", "q3"])
+def test_tpch_golden_parity_analysis_on(tpch_session, qname):
+    """Byte-identical results with the analyzer on (non-strict), zero
+    findings on the real plans, golden parity vs the independent pandas
+    implementation."""
+    session = tpch_session
+    session.conf.set(ENABLED_KEY, "false")
+    t_off = Q.QUERIES[qname](session)._qe().collect()
+    session.conf.set(ENABLED_KEY, "true")
+    session.conf.set(JAXPR_KEY, "on")
+    qe = Q.QUERIES[qname](session)._qe()
+    t_on = qe.collect()
+    assert t_on.equals(t_off)  # byte-identical Arrow tables
+    assert qe.analysis_findings == [], qe.analysis_findings
+    got = G.normalize_decimals(t_on.to_pandas()).reset_index(drop=True)
+    G.compare(got, G.GOLDEN[qname](session._tpch_analysis_path))
+
+
+# -- lint framework -----------------------------------------------------------
+
+def test_lint_all_clean_on_real_tree():
+    from spark_tpu.analysis.lints import run_passes
+    assert [v.render() for v in run_passes()] == []
+
+
+def test_lint_cli_run_helper():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "lint_cli", os.path.join(root, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.run() == []
+    with pytest.raises(ValueError, match="unknown lint pass"):
+        mod.run(["not-a-pass"])
+
+
+def _tmp_repo(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def test_metric_prefix_pass_synthetic(tmp_path):
+    from spark_tpu.analysis.lints import run_passes
+    repo = _tmp_repo(tmp_path, {
+        "spark_tpu/bad.py":
+            "ctx.add_metric('made_up_name', 1)\n"
+            "ctx.add_metric(f'{x}_dynamic', 1)\n"
+            "ctx.add_metric('rows_fine', 1)\n"})
+    out = run_passes(["metric-prefix"], repo=repo)
+    msgs = [v.message for v in out]
+    assert len(out) == 2, out
+    assert any("made_up_name" in m for m in msgs)
+    assert any("not statically attributable" in m for m in msgs)
+
+
+def test_conf_key_pass_synthetic(tmp_path):
+    from spark_tpu.analysis.lints import run_passes
+    repo = _tmp_repo(tmp_path, {
+        "spark_tpu/bad.py":
+            "x = conf.get('spark_tpu.sql.not.registered')\n"
+            "BAD_KEY = 'spark_tpu.also.not.registered'\n"
+            "ok = conf.get('spark_tpu.sql.shuffle.partitions')\n"})
+    out = run_passes(["conf-key"], repo=repo)
+    assert len(out) == 2, out
+    assert {v.line for v in out} == {1, 2}
+    assert all("unregistered conf key" in v.message for v in out)
+
+
+def test_fault_site_pass_synthetic(tmp_path):
+    from spark_tpu.analysis.lints import run_passes
+    repo = _tmp_repo(tmp_path, {
+        "spark_tpu/engine.py":
+            "faults.fire('scan_load')\n"
+            "faults.fire('bogus_seam')\n",
+        "tests/test_x.py":
+            "spec = 'stage_rnu:fatal:1'\n"})
+    out = run_passes(["fault-site"], repo=repo)
+    msgs = [v.render() for v in out]
+    assert any("bogus_seam" in m for m in msgs), msgs
+    assert any("stage_rnu" in m for m in msgs), msgs
+    # sites declared in KNOWN_SITES but unwired in this (synthetic)
+    # tree are reported against the faults module
+    unwired = [v for v in out
+               if v.path == "spark_tpu/testing/faults.py"]
+    assert unwired and all("no faults.fire" in v.message
+                           for v in unwired)
+
+
+def test_fault_site_pass_register_site_escape(tmp_path):
+    from spark_tpu.analysis.lints import run_passes
+    repo = _tmp_repo(tmp_path, {
+        "tests/test_x.py":
+            "faults.register_site('my_seam')\n"
+            "plan.fire('my_seam')\n"
+            "spec = 'my_seam:fatal:1'\n"})
+    out = [v for v in run_passes(["fault-site"], repo=repo)
+           if "my_seam" in v.message]
+    assert out == []
+
+
+def test_tracer_leak_pass_synthetic(tmp_path):
+    from spark_tpu.analysis.lints import run_passes
+    repo = _tmp_repo(tmp_path, {
+        "spark_tpu/execution/bad.py":
+            "k = hash(col.data)\n"
+            "ok = hash('literal')\n"
+            "b = bool(jnp.any(x))\n"
+            "fine = bool(flag_value)\n",
+        "spark_tpu/other.py":
+            "h = hash(x)  # out of scope: not execution/ or parallel/\n"})
+    out = run_passes(["tracer-leak"], repo=repo)
+    assert {v.line for v in out} == {1, 3}, out

@@ -76,29 +76,61 @@ def _check_golden(got, tpch_path, qname):
 # -- spec parsing / plan mechanics -------------------------------------------
 
 def test_spec_parse_and_fire_once():
-    plan = FaultPlan("s:unavailable:2,s:fatal:3")
-    plan.fire("s")  # hit 1: below nth
-    with pytest.raises(FaultInjected, match="UNAVAILABLE"):
-        plan.fire("s")  # hit 2
-    with pytest.raises(FaultInjected, match="INTERNAL"):
-        plan.fire("s")  # hit 3: second rule
-    plan.fire("s")  # hit 4: both rules spent
-    assert plan.fired_log == [("s", 2, "unavailable"), ("s", 3, "fatal")]
-    assert plan.hits["s"] == 4
+    with faults.scoped_site("s"):
+        plan = FaultPlan("s:unavailable:2,s:fatal:3")
+        plan.fire("s")  # hit 1: below nth
+        with pytest.raises(FaultInjected, match="UNAVAILABLE"):
+            plan.fire("s")  # hit 2
+        with pytest.raises(FaultInjected, match="INTERNAL"):
+            plan.fire("s")  # hit 3: second rule
+        plan.fire("s")  # hit 4: both rules spent
+        assert plan.fired_log == [("s", 2, "unavailable"),
+                                  ("s", 3, "fatal")]
+        assert plan.hits["s"] == 4
 
 
 def test_spec_sites_independent():
-    plan = FaultPlan("a:deadline:1")
-    plan.fire("b")  # other sites never interfere
-    with pytest.raises(FaultInjected, match="DEADLINE_EXCEEDED"):
-        plan.fire("a")
+    with faults.scoped_site("a"), faults.scoped_site("b"):
+        plan = FaultPlan("a:deadline:1")
+        plan.fire("b")  # other sites never interfere
+        with pytest.raises(FaultInjected, match="DEADLINE_EXCEEDED"):
+            plan.fire("a")
 
 
-@pytest.mark.parametrize("bad", ["x:resource_exhausted", "x:nope:1",
-                                 "x:slow:0", "justasite"])
+@pytest.mark.parametrize("bad", ["scan_load:resource_exhausted",
+                                 "scan_load:nope:1",
+                                 "scan_load:slow:0", "justasite"])
 def test_spec_rejects_malformed(bad):
     with pytest.raises(ValueError):
         FaultPlan(bad)
+
+
+def test_spec_rejects_unknown_site():
+    """The PR-4 satellite bug: a typo'd site (`stage_rnu`) used to parse
+    fine and then silently never fire — the chaos test tested nothing.
+    Parse-time validation against the wired-seam registry makes the
+    typo loud."""
+    typo = "stage_rnu"  # f-strings below keep the deliberate typo
+    # invisible to the fault-site lint pass (static literals only)
+    with pytest.raises(ValueError, match="unknown fault site 'stage_rnu'"):
+        FaultPlan(f"{typo}:fatal:1")
+    # conf-driven arming goes through the same parser
+    from spark_tpu.config import Conf
+    conf = Conf()
+    conf.set(faults.INJECT_KEY, f"shuffle:unavailable:1,{typo}:fatal:1")
+    faults.reset()
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.arm(conf)
+    faults.reset()
+    # scoped_site opens an ad-hoc seam for test-planted fire() points,
+    # and closes it again: a leaked registration would re-open the
+    # silent-no-fire hole for the rest of the process
+    with faults.scoped_site("my_test_seam"):
+        plan = FaultPlan("my_test_seam:fatal:1")
+        with pytest.raises(FaultInjected, match="INTERNAL"):
+            plan.fire("my_test_seam")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan("my_test_seam:fatal:1")  # registration is gone
 
 
 def test_inject_context_restores(tpch_session):
