@@ -286,6 +286,11 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
             b, _, _ = qe.execute_batch()
             return qe, b.to_arrow().to_pandas()
 
+        # partial-progress recovery sidecar: chunks replayed by the
+        # per-chunk retry across this query's runs. MUST stay 0 on a
+        # clean run — nonzero means the TPU runtime flaked mid-stream
+        # (and the stream resumed instead of restarting)
+        rec0 = spark.metrics.counter("rec_chunks_replayed").value
         _, got = run_once()  # warmup (compile + first ingest)
         times = []
         qe = None
@@ -313,6 +318,8 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
                 sum(c.get("bytes_accessed") or 0 for c in costs))
             extra[f"tpch_{name}_sf{sf:g}_peak_hbm_bytes"] = int(max(
                 c.get("peak_hbm_bytes") or 0 for c in costs))
+        extra[f"tpch_{name}_sf{sf:g}_rec_chunks_replayed"] = int(
+            spark.metrics.counter("rec_chunks_replayed").value - rec0)
         # static-analyzer sidecar: findings per query (the BENCH
         # trajectory must show analyzer noise staying at zero on the
         # TPC-H suite; a nonzero count is either a real hazard at this

@@ -13,7 +13,11 @@
 #      emission) is known-runnable before the driver spends a TPU slot
 #   4. chaos smoke: one injected OOM + one injected transient against
 #      TPC-H Q1 with golden parity — the failure-recovery ladder
-#      (executor taxonomy + fault injection) must survive end-to-end
+#      (executor taxonomy + fault injection) must survive end-to-end —
+#      plus one mid-stream `stream_chunk` fault against chunked Q1
+#      asserting a `chunk_retry` recovery action (partial-progress
+#      recovery replays ONE chunk, never restarts the stream) with
+#      golden parity
 #   5. observability + analysis smoke: TPC-H Q1/Q3 with eventLog +
 #      trace + Prometheus sinks on AND the pre-compile static analyzer
 #      explicitly enabled (enabled=true, non-strict); golden parity
@@ -121,9 +125,27 @@ with warnings.catch_warnings():
 assert qe.fault_summary.get("oom_cache_evict", 0) >= 1, qe.fault_summary
 assert qe.fault_summary.get("transient_retry", 0) >= 1, qe.fault_summary
 G.compare(got.reset_index(drop=True), G.GOLDEN["q1"](path))
+
+# mid-stream fault: partial-progress recovery (execution/recovery.py)
+# must replay ONE chunk (chunk_retry) — never surface to the
+# whole-query loop and restart the stream (no transient_retry)
+spark.conf.set("spark_tpu.sql.execution.streamingChunkRows", 1024)
+spark.conf.set("spark_tpu.sql.io.deviceCacheBytes", 0)
+spark._stage_cache.clear()
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    with faults.inject(spark.conf, "stream_chunk:unavailable:2") as fp:
+        qe2 = Q.QUERIES["q1"](spark)._qe()
+        got2 = G.normalize_decimals(qe2.collect().to_pandas())
+assert fp.fired_log, "stream_chunk never fired — smoke is vacuous"
+assert qe2.fault_summary.get("chunk_retry", 0) == 1, qe2.fault_summary
+assert "transient_retry" not in qe2.fault_summary, qe2.fault_summary
+G.compare(got2.reset_index(drop=True), G.GOLDEN["q1"](path))
 print(json.dumps({"preflight_chaos_smoke": "ok",
                   "fault_summary": {k: v for k, v in
-                                    qe.fault_summary.items()}}))
+                                    qe.fault_summary.items()},
+                  "stream_fault_summary": {k: v for k, v in
+                                           qe2.fault_summary.items()}}))
 EOF
 
 echo "-- stage 5/6: observability + analysis smoke --"

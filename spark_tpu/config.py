@@ -179,6 +179,38 @@ EXEC_STAGE_TIMEOUT_MS = register(
         "sync. A blown deadline raises StageTimeoutError and retries "
         "under the maxRetries budget. 0 disables.")
 
+CHUNK_RETRY_ENABLED = register(
+    "spark_tpu.execution.chunkRetry.enabled", True,
+    doc="Chunk-granular retry inside the streaming drivers "
+        "(execution/recovery.py): a TRANSIENT/TIMEOUT failure while "
+        "streaming replays only the failed chunk against the carried "
+        "accumulator state, instead of surfacing to the whole-query "
+        "retry loop and re-ingesting from chunk 0. Recoveries are "
+        "recorded as `chunk_retry` actions in fault_summary and the "
+        "`rec_chunks_replayed` counter.")
+
+CHUNK_RETRY_MAX = register(
+    "spark_tpu.execution.chunkRetry.maxRetries", 2,
+    doc="Per-CHUNK retry budget for the streaming drivers (a fresh "
+        "exponential-backoff RetryPolicy per chunk, the "
+        "spark.task.maxFailures discipline — per task attempt, not "
+        "per stream). Backoff follows spark_tpu.execution.backoffMs. "
+        "0 disables chunk retry (failures surface to the whole-query "
+        "ladder).",
+    validator=lambda v: v >= 0)
+
+CHECKPOINT_EVERY_CHUNKS = register(
+    "spark_tpu.execution.checkpoint.everyChunks", 8,
+    doc="Mesh streaming checkpoint cadence: every N consumed chunks, "
+        "snapshot the per-shard accumulator state device->host as a "
+        "partial-aggregate Arrow table (bytes counted in "
+        "rec_ckpt_bytes). On a mesh failure, the single-device "
+        "fallback re-plan resumes the stream at the last checkpointed "
+        "chunk cursor instead of chunk 0 (recorded as "
+        "`checkpoint_restore`). 0 disables checkpointing (fallback "
+        "restarts from scratch).",
+    validator=lambda v: v >= 0)
+
 MESH_FALLBACK_ENABLED = register(
     "spark_tpu.execution.meshFallback.enabled", True,
     doc="When a distributed run fails inside the mesh/collective path "

@@ -67,6 +67,10 @@ class QueryExecution:
         self._last_stage_key: Optional[str] = None
         self.fault_summary: Dict[str, object] = {}
         self.fault_events: list = []
+        # partial-progress recovery (execution/recovery.py): chunk
+        # retrier conf + stage-output memo + mesh checkpoints, created
+        # per execute_batch / external collect
+        self._recovery = None
         # pre-compile static analysis (spark_tpu/analysis/): typed
         # findings from the plan walk + (gated) jaxpr walk; None until
         # the analyzer ran for this execution
@@ -279,54 +283,95 @@ class QueryExecution:
         for c in node.children:
             self._collect_scans(c, out)
 
+    def _splice_stream(self, node: P.PhysicalPlan, tagged):
+        """Splice one streamed-aggregate result back into the plan.
+        `tagged` is ("direct", Batch) / ("mesh", partial Batch) /
+        ("spill", (host partial table, partial node)) — the same tagged
+        value the recovery stage-output memo retains, so a recovery
+        re-execution rebuilds the splice without re-streaming."""
+        kind, result = tagged
+        if kind == "direct":
+            return P.InputExec(result, node.schema(), label="streamed_agg")
+        if kind == "mesh":
+            spliced = P.InputExec(result, node.schema(),
+                                  label="streamed_partial_agg")
+            # the final aggregate above resolves its functions
+            # against the PRE-aggregation schema
+            spliced._agg_base_schema = node._base_schema()
+            return spliced
+        # "spill": host-spilled partials re-reduce in a FINAL aggregate
+        # (the partial -> exchange -> final split of AggUtils.scala,
+        # with host Arrow buffers in the exchange's seat)
+        from ..columnar import bucket_capacity
+        from ..expr import ColumnRef
+        partial_table, partial_node = result
+        inp = P.InputExec(Batch.from_arrow(partial_table),
+                          partial_node.schema(),
+                          label="spilled_partials")
+        inp._agg_base_schema = node._base_schema()
+        final_groups = [ColumnRef(g.name()) for g in node.group_exprs]
+        final = P.HashAggregateExec(
+            inp, final_groups, node.agg_exprs, mode="final",
+            est_groups=bucket_capacity(max(partial_table.num_rows, 8)))
+        final.tag = node.tag
+        self.spilled_partial_rows = partial_table.num_rows
+        return final
+
     def _materialize_streaming(self, node: P.PhysicalPlan,
                                mesh=None) -> P.PhysicalPlan:
         """Execute streamable aggregates eagerly (chunked, accumulator
         carry) and splice their results back as InputExec leaves. Under a
         mesh, PARTIAL aggregates over chunked scans stream with per-shard
-        tables (the exchange + final stages above run unchanged)."""
-        from .streaming_agg import (stream_scan_aggregate_mesh,
+        tables (the exchange + final stages above run unchanged).
+
+        Completed streams land in the recovery stage-output memo (the
+        surviving-shuffle-file analog): when a downstream failure
+        re-executes the query, the splice replays from the memo instead
+        of re-ingesting the stream. After a mesh failure, a matching
+        mesh checkpoint resumes the stream at its chunk cursor."""
+        from .streaming_agg import (resume_from_mesh_checkpoint,
+                                    stream_scan_aggregate_mesh,
                                     try_stream_aggregate,
                                     try_stream_aggregate_spill)
+        rec = self._recovery
+        cache = self.session._stage_cache
         if mesh is None and isinstance(node, P.HashAggregateExec):
-            result = try_stream_aggregate(node, self._conf,
-                                          self.session._stage_cache)
+            memo_key = ("stream", id(node))
+            if rec is not None:
+                hit = rec.memo_get(memo_key, label=node.simple_string())
+                if hit is not None:
+                    return self._splice_stream(node, hit)
+                if self._mesh_fallback:
+                    resumed = resume_from_mesh_checkpoint(
+                        node, self._conf, cache, rec)
+                    if resumed is not None:
+                        rec.memo_put(memo_key, ("spill", resumed))
+                        return self._splice_stream(node,
+                                                   ("spill", resumed))
+            result = try_stream_aggregate(node, self._conf, cache, rec)
             if result is not None:
-                return P.InputExec(result, node.schema(), label="streamed_agg")
-            spill = try_stream_aggregate_spill(node, self._conf,
-                                               self.session._stage_cache)
+                if rec is not None:
+                    rec.memo_put(memo_key, ("direct", result))
+                return self._splice_stream(node, ("direct", result))
+            spill = try_stream_aggregate_spill(node, self._conf, cache,
+                                               rec)
             if spill is not None:
-                # out-of-core: host-spilled partials re-reduce in a
-                # FINAL aggregate (the partial -> exchange -> final
-                # split of AggUtils.scala, with host Arrow buffers in
-                # the exchange's seat)
-                from ..expr import ColumnRef
-                partial_table, partial_node = spill
-                inp = P.InputExec(Batch.from_arrow(partial_table),
-                                  partial_node.schema(),
-                                  label="spilled_partials")
-                inp._agg_base_schema = node._base_schema()
-                final_groups = [ColumnRef(g.name())
-                                for g in node.group_exprs]
-                from ..columnar import bucket_capacity
-                final = P.HashAggregateExec(
-                    inp, final_groups, node.agg_exprs, mode="final",
-                    est_groups=bucket_capacity(
-                        max(partial_table.num_rows, 8)))
-                final.tag = node.tag
-                self.spilled_partial_rows = partial_table.num_rows
-                return final
+                if rec is not None:
+                    rec.memo_put(memo_key, ("spill", spill))
+                return self._splice_stream(node, ("spill", spill))
         if mesh is not None and isinstance(node, P.HashAggregateExec) \
                 and node.mode == "partial":
+            memo_key = ("stream_mesh", id(node))
+            if rec is not None:
+                hit = rec.memo_get(memo_key, label=node.simple_string())
+                if hit is not None:
+                    return self._splice_stream(node, hit)
             result = stream_scan_aggregate_mesh(
-                node, mesh, self._conf, self.session._stage_cache)
+                node, mesh, self._conf, cache, rec)
             if result is not None:
-                spliced = P.InputExec(result, node.schema(),
-                                      label="streamed_partial_agg")
-                # the final aggregate above resolves its functions
-                # against the PRE-aggregation schema
-                spliced._agg_base_schema = node._base_schema()
-                return spliced
+                if rec is not None:
+                    rec.memo_put(memo_key, ("mesh", result))
+                return self._splice_stream(node, ("mesh", result))
         new_children = tuple(self._materialize_streaming(c, mesh)
                              for c in node.children)
         if new_children != node.children:
@@ -350,7 +395,7 @@ class QueryExecution:
             node.children = new_children
         if isinstance(node, P.GenerateExec):
             from .streaming_agg import _materialize_subtree
-            b = _materialize_subtree(node, self._conf)
+            b = _materialize_subtree(node, self._conf, self._recovery)
             return P.InputExec(b, node.schema(), label="generated")
         return node
 
@@ -731,11 +776,14 @@ class QueryExecution:
         from ..observability.listener import QueryStartEvent
         from ..testing import faults
         from .failures import RetryPolicy
+        from .recovery import RecoveryContext
         self._activate_conf()
         faults.arm(self.session.conf)
         conf = self._conf
         self.fault_summary = {}
         self.fault_events = []
+        self._recovery = RecoveryContext(metrics=self.session.metrics,
+                                         record=self._record_fault)
         # NOTE: _analysis_posted is NOT reset here — it is
         # per-QueryExecution, so an external-collect attempt that falls
         # through to execute_batch (or a re-executed qe) posts the
@@ -757,6 +805,10 @@ class QueryExecution:
                     return self._execute_recover()
                 except _ReplanRequest:
                     self._executed = None  # re-plan with _join_overrides
+                    # the rebuilt plan has fresh node identities and
+                    # different shapes: memoized stage outputs no
+                    # longer splice (epoch bump)
+                    self._recovery.invalidate()
                     self.spans.mark("aqe_replan", kind="join_strategy")
             # replan budget exhausted: finish with capacity growth only
             self._no_more_replans = True
@@ -768,6 +820,10 @@ class QueryExecution:
             raise
         finally:
             self.session._exec_depth -= 1
+            if self._recovery is not None:
+                # the memo spans recovery loops, not executions: drop
+                # retained device batches / checkpoint tables now
+                self._recovery.release()
             if self.session._exec_depth == 0:
                 # implicit (WITH-clause) materializations are statement
                 # -scoped: evict when the outermost execution finishes
@@ -801,6 +857,13 @@ class QueryExecution:
                     ev["site"] = site
             ev.update(extra)
             self.fault_events.append(ev)
+        else:
+            # the 32-entry cap used to drop later events SILENTLY —
+            # count the truncation so history/event-log consumers can
+            # see the record list is incomplete (the action counters
+            # above still count everything)
+            self.fault_summary["events_dropped"] = int(
+                self.fault_summary.get("events_dropped", 0)) + 1
         self.spans.mark(f"retry:{action}", error=error[:120])
         if self._observe_events:
             self.session.listeners.post("on_fault", FaultEvent(
@@ -811,14 +874,20 @@ class QueryExecution:
         """Run `_execute_batch_inner` under the failure taxonomy: each
         iteration either returns, re-raises (_ReplanRequest, FATAL,
         exhausted budgets), or applies one recovery action and loops."""
+        last: Optional[Exception] = None
         for _ in range(32):  # every action below consumes a bounded budget
             try:
                 return self._execute_batch_inner()
             except _ReplanRequest:
                 raise
             except Exception as e:  # noqa: BLE001
+                last = e
                 self._handle_failure(e)  # raises when unrecoverable
-        raise RuntimeError("stage failure recovery did not converge")
+        raise RuntimeError(
+            f"stage failure recovery did not converge after 32 recovery "
+            f"actions; fault_summary={self.fault_summary}; last error: "
+            + ("<none>" if last is None
+               else f"{type(last).__name__}: {str(last)[:300]}"))
 
     def _handle_failure(self, e: Exception) -> None:
         """One step of the recovery ladder. Returns after applying a
@@ -841,6 +910,12 @@ class QueryExecution:
                           f"(mesh_fallback): {msg[:160]}")
             self._record_fault("mesh_fallback", e)
             self._mesh_fallback = True
+            if self._recovery is not None:
+                # single-device shapes differ: memoized mesh-stage
+                # outputs cannot splice (checkpoints survive — the
+                # fallback resumes the stream from them)
+                self._recovery.invalidate()
+                self._recovery.begin_recovery_attempt()
             overlay = Conf(parent=conf)
             overlay.set("spark_tpu.sql.mesh.size", 0)
             self._exec_conf = overlay
@@ -867,6 +942,10 @@ class QueryExecution:
                 f"({self._retry_policy.remaining} left, "
                 f"backoff {slept:.0f}ms): {msg[:160]}")
             self._record_fault(action, e, backoff_ms=round(slept, 1))
+            if self._recovery is not None:
+                # shapes unchanged: completed upstream stage outputs
+                # replay from the memo on the re-execution
+                self._recovery.begin_recovery_attempt()
             # drop only THIS stage's compiled entry so the retry
             # recompiles (and trace-time injection sites re-fire
             # deterministically) — except on TIMEOUT: the program was
@@ -893,6 +972,14 @@ class QueryExecution:
                 warnings.warn(f"RESOURCE_EXHAUSTED: evicted device cache "
                               f"({freed} bytes) and retrying: {msg[:160]}")
                 self._record_fault("oom_cache_evict", e, freed_bytes=freed)
+                if self._recovery is not None:
+                    # the memo pins device-resident stage outputs
+                    # (build sides, streamed splices): under memory
+                    # pressure they are part of the storage pool this
+                    # rung exists to evict — drop them so the retry
+                    # runs unpinned (reuse is lost, memory is freed)
+                    self._recovery.invalidate()
+                    self._recovery.begin_recovery_attempt()
                 return
             if self._oom_rung == 2 and bool(conf.get(
                     "spark_tpu.execution.oom.spillOnExhausted")):
@@ -903,6 +990,10 @@ class QueryExecution:
                               f"through the host-spill chunked path: "
                               f"{msg[:160]}")
                 self._record_fault("oom_spill_reroute", e)
+                if self._recovery is not None:
+                    # the deviceBudget re-plan changes streaming shapes
+                    self._recovery.invalidate()
+                    self._recovery.begin_recovery_attempt()
                 overlay = Conf(parent=conf)
                 overlay.set("spark_tpu.sql.memory.deviceBudget", 1)
                 chunk = int(conf.get(
@@ -1363,8 +1454,12 @@ class QueryExecution:
             "spark_tpu.sql.memory.deviceBudget"))
         if budget <= 0:
             return None
+        import warnings
+        from ..testing import faults
         from .external import try_external_collect
+        from .failures import FailureClass, RetryPolicy, classify
         from .python_eval import plan_has_udfs
+        from .recovery import RecoveryContext
         self._activate_conf()
         if plan_has_udfs(self.executed_plan):
             return None  # UDF stages evaluate through execute_batch
@@ -1374,10 +1469,48 @@ class QueryExecution:
         self._observe_events = self._events_enabled()
         self._analyze_plan_phase()
         self._post_analysis(self._analysis_conf()[1])
+        # chunk-granular retry covers this path too: arm conf-driven
+        # injection and record chunk_retry actions on THIS execution
+        # (counters reset like execute_batch — repeated collects must
+        # not accumulate stale actions)
+        faults.arm(self.session.conf)
+        self.fault_summary = {}
+        self.fault_events = []
+        self._recovery = RecoveryContext(metrics=self.session.metrics,
+                                         record=self._record_fault)
         t0 = time.perf_counter()
-        out = try_external_collect(self.session, self.executed_plan,
-                                   self.session.conf,
-                                   self.session._stage_cache)
+        conf = self.session.conf
+        # transient rung for the egress path (the execute_batch ladder
+        # never sees these streams): a flake that exhausts the
+        # per-chunk budget restarts the whole external stream under
+        # the same maxRetries/backoff budget instead of aborting
+        policy = RetryPolicy(
+            max_retries=self._max_retries(conf),
+            backoff_ms=float(conf.get("spark_tpu.execution.backoffMs")))
+        try:
+            while True:
+                try:
+                    out = try_external_collect(
+                        self.session, self.executed_plan, conf,
+                        self.session._stage_cache, self._recovery)
+                    break
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if classify(e) not in (FailureClass.TRANSIENT,
+                                           FailureClass.TIMEOUT):
+                        raise
+                    slept = policy.attempt_retry()
+                    if slept is None:
+                        raise
+                    warnings.warn(
+                        f"transient stage failure, retrying external "
+                        f"collect ({policy.remaining} left, backoff "
+                        f"{slept:.0f}ms): {type(e).__name__}: "
+                        f"{str(e)[:160]}")
+                    self._record_fault("transient_retry", e,
+                                       backoff_ms=round(slept, 1))
+                    self._recovery.begin_recovery_attempt()
+        finally:
+            self._recovery.release()
         if out is not None:
             self.phase_times["external"] = time.perf_counter() - t0
         return out

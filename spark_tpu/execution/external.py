@@ -31,6 +31,7 @@ import pyarrow.compute as pc
 
 from ..columnar import Batch, bucket_capacity
 from ..plan import physical as P
+from .recovery import ChunkRetrier
 from .streaming_agg import (CHUNK_ROWS_KEY, _CHUNKABLE_JOINS,
                             _replay_chain, apply_join_overflow,
                             prepare_chunk_joins)
@@ -90,8 +91,8 @@ def _host_sort_keys(sort: P.SortExec, schema) -> Optional[Tuple]:
 
 
 def try_external_collect(session, plan: P.PhysicalPlan, conf,
-                         cache: Optional[dict] = None
-                         ) -> Optional[pa.Table]:
+                         cache: Optional[dict] = None,
+                         recovery=None) -> Optional[pa.Table]:
     budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
     if budget <= 0:
         return None
@@ -124,7 +125,7 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
         return None
 
     joins, builds, _saved = prepare_chunk_joins(
-        chain, conf, first.capacity)
+        chain, conf, first.capacity, recovery)
 
     topn = sort is not None and limit is not None
 
@@ -161,15 +162,21 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
         raise RuntimeError("external-collect join capacity did not "
                            "converge")
 
-    import itertools
+    # chunk-granular retry (execution/recovery.py): a transient fault
+    # replays only the failed chunk — nothing already spilled re-runs
+    retrier = ChunkRetrier(conf, recovery)
     spilled: List[pa.Table] = []
     total_rows = 0
-    for b in itertools.chain([first], chunks):
-        t = run_chunk(b).to_arrow()
+    ci = 0
+    b = first
+    while b is not None:
+        t = retrier.run(lambda bb=b: run_chunk(bb).to_arrow(), chunk=ci)
         spilled.append(t)
         total_rows += t.num_rows
         if limit is not None and sort is None and total_rows >= limit.n:
             break  # plain LIMIT: enough live rows spilled
+        ci += 1
+        b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
 
     table = pa.concat_tables(spilled, promote_options="permissive")
 

@@ -101,11 +101,12 @@ def classify(exc: BaseException) -> FailureClass:
 
 def is_mesh_failure(exc: BaseException) -> bool:
     """True when the failure points at the mesh/collective path (or a
-    synthetic fault at the `mesh` site): the candidate set for the
-    single-device fallback re-plan."""
+    synthetic fault at the `mesh` / `mesh_checkpoint` sites — the
+    latter models a host lost mid-stream at a snapshot point): the
+    candidate set for the single-device fallback re-plan."""
     from ..testing.faults import FaultInjected
     if isinstance(exc, FaultInjected):
-        return exc.site == "mesh"
+        return exc.site in ("mesh", "mesh_checkpoint")
     msg = f"{type(exc).__name__}: {exc}"
     return any(t in msg for t in _MESH_TOKENS)
 

@@ -28,6 +28,7 @@ from .. import types as T
 from ..columnar import Batch, Column, bucket_capacity
 from ..plan import physical as P
 from . import aggregate as agg_kernels
+from .recovery import CHECKPOINT_EVERY_KEY, ChunkRetrier
 
 CHUNK_ROWS_KEY = "spark_tpu.sql.execution.streamingChunkRows"
 
@@ -104,7 +105,7 @@ def apply_join_overflow(flags, metrics, joins) -> bool:
     return True
 
 
-def prepare_chunk_joins(chain: List, conf, first_cap: int):
+def prepare_chunk_joins(chain: List, conf, first_cap: int, recovery=None):
     """Shared chunk-driver setup: materialize each probe-side join's
     build subtree once (QueryStageExec role) and seed missing output
     capacities with the CHUNK capacity. Returns (joins, builds,
@@ -112,7 +113,7 @@ def prepare_chunk_joins(chain: List, conf, first_cap: int):
     AQE cap harvest persists them — callers restore `saved_caps` only
     when aborting before any chunk ran."""
     joins = [op for op in chain if isinstance(op, P.JoinExec)]
-    builds = {j.tag: _materialize_subtree(j.children[1], conf)
+    builds = {j.tag: _materialize_subtree(j.children[1], conf, recovery)
               for j in joins}
     saved_caps = {j.tag: j.out_cap for j in joins}
     for j in joins:
@@ -121,10 +122,18 @@ def prepare_chunk_joins(chain: List, conf, first_cap: int):
     return joins, builds, saved_caps
 
 
-def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
+def _materialize_subtree(root: P.PhysicalPlan, conf, recovery=None) -> Batch:
     """Compile + run an independent subtree (a join's build side) with
     its own AQE capacity-retry loop — a stage materialization, like the
-    reference's QueryStageExec."""
+    reference's QueryStageExec. Completed materializations land in the
+    recovery stage-output memo (the surviving-shuffle-file analog), so
+    a downstream failure's re-execution replays them instead of
+    re-running."""
+    if recovery is not None:
+        hit = recovery.memo_get(("build", id(root)),
+                                label=root.simple_string())
+        if hit is not None:
+            return hit
     scans: List[P.LeafExec] = []
 
     def collect(n):
@@ -164,6 +173,8 @@ def _materialize_subtree(root: P.PhysicalPlan, conf) -> Batch:
                                      "exch_overflow_", "agg_overflow_"))
                     and bool(v)]
         if not overflow:
+            if recovery is not None:
+                recovery.memo_put(("build", id(root)), batch)
             return batch
         if not adaptive and any(not k.startswith("join_nonunique_")
                                 for k in overflow):
@@ -254,7 +265,8 @@ def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,
 
 def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
                           leaf: P.ScanExec, conf,
-                          cache: Optional[dict] = None) -> Optional[Batch]:
+                          cache: Optional[dict] = None,
+                          recovery=None) -> Optional[Batch]:
     """Run agg over a chunked Scan: host ingests record-batch chunks
     (uniform bucketed capacity so the update step compiles once) while the
     device reduces — the double-buffered host->HBM pipeline of SURVEY.md
@@ -267,7 +279,7 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
         return None
 
     joins, builds, saved_caps = prepare_chunk_joins(
-        chain, conf, first.capacity)
+        chain, conf, first.capacity, recovery)
 
     def make_update():
         key = f"stream_scan:{agg.describe()}:{chunk_rows}"
@@ -347,13 +359,18 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
             check_bound(b)
         raise RuntimeError("streamed join capacity did not converge")
 
-    check_dicts(first)
-    tables = run_chunk(tables, first)
-    row_base += chunk_stride(first)
-    for b in chunks:
+    # chunk-granular retry (execution/recovery.py): carry state only
+    # advances after a chunk succeeds, so a TRANSIENT fault replays
+    # exactly the failed chunk against the pre-chunk tables
+    retrier = ChunkRetrier(conf, recovery)
+    ci = 0
+    b = first
+    while b is not None:
         check_dicts(b)
-        tables = run_chunk(tables, b)
+        tables = retrier.run(lambda bb=b: run_chunk(tables, bb), chunk=ci)
         row_base += chunk_stride(b)
+        ci += 1
+        b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
 
     dict_overrides = dict(chunks.dictionaries) if hasattr(
         chunks, "dictionaries") else {}
@@ -362,7 +379,9 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
 
 def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
                                 leaf: P.ScanExec, conf,
-                                cache: Optional[dict] = None):
+                                cache: Optional[dict] = None,
+                                recovery=None, skip_chunks: int = 0,
+                                seed_partials: Optional[List] = None):
     """Out-of-core aggregation for UNBOUNDED group keys (no static
     domain — e.g. TPC-H Q3's l_orderkey): stream probe chunks through
     device-resident build sides, reduce each chunk with a PARTIAL-mode
@@ -372,18 +391,23 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
     disk plays for `UnsafeExternalSorter.java:1` /
     `ExternalAppendOnlyMap.scala:55`. Returns (concatenated host partial
     table, partial node) for the caller to re-reduce with a FINAL
-    aggregate; None when the shape doesn't apply."""
+    aggregate; None when the shape doesn't apply.
+
+    The checkpoint-restore path reuses this driver to RESUME a failed
+    mesh stream single-device: `skip_chunks` advances the chunk cursor
+    past what the checkpoint already covers, and `seed_partials`
+    prepends the checkpointed partial tables to the spill list."""
     import copy
+    import pyarrow as pa
 
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
     chunks = leaf.source.load_chunks(leaf.required_columns,
                                      leaf.pushed_filters, chunk_rows)
+    if skip_chunks:
+        if not hasattr(chunks, "skip_chunks") or \
+                chunks.skip_chunks(skip_chunks) < skip_chunks:
+            return None  # stream shorter than the checkpoint cursor
     first = next(iter(chunks), None)
-    if first is None:
-        return None
-
-    joins, builds, saved_caps = prepare_chunk_joins(
-        chain, conf, first.capacity)
 
     partial = copy.copy(agg)
     partial.mode = "partial"
@@ -391,6 +415,17 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
     # can never have more groups than rows, so the per-chunk partial
     # needs no overflow retry of its own
     partial.est_groups = None
+
+    if first is None:
+        if seed_partials:
+            # resume landed exactly at end-of-stream: the checkpoint
+            # already covers every chunk
+            return pa.concat_tables(list(seed_partials),
+                                    promote_options="permissive"), partial
+        return None
+
+    joins, builds, saved_caps = prepare_chunk_joins(
+        chain, conf, first.capacity, recovery)
 
     def make_update():
         key = f"stream_spill:{agg.describe()}:{chunk_rows}"
@@ -408,7 +443,6 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
         return fn
 
     update_fn = make_update()
-    spilled: List = []
 
     def run_chunk(b):
         nonlocal update_fn
@@ -424,20 +458,28 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
 
     # spill each chunk's compacted partial to host; dictionary-encoded
     # group keys decode to strings here, so per-chunk dictionaries unify
-    # value-wise in the concat (no shared-encoding requirement)
-    spilled.append(run_chunk(first).to_arrow())
-    for b in chunks:
-        spilled.append(run_chunk(b).to_arrow())
+    # value-wise in the concat (no shared-encoding requirement). The
+    # host pull rides inside the retried step: a flake during to_arrow
+    # replays only this chunk (its partial was not yet spilled).
+    retrier = ChunkRetrier(conf, recovery)
+    spilled: List = list(seed_partials or [])
+    ci = int(skip_chunks)
+    b = first
+    while b is not None:
+        spilled.append(retrier.run(
+            lambda bb=b: run_chunk(bb).to_arrow(), chunk=ci))
+        ci += 1
+        b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
     for j in joins:
         j.out_cap = saved_caps[j.tag] if saved_caps[j.tag] is not None \
             else j.out_cap
-    import pyarrow as pa
     table = pa.concat_tables(spilled, promote_options="permissive")
     return table, partial
 
 
 def try_stream_aggregate_spill(agg: "P.HashAggregateExec", conf,
-                               cache: Optional[dict] = None):
+                               cache: Optional[dict] = None,
+                               recovery=None):
     """deviceBudget gate for the out-of-core partial-spill path: engages
     only when the probe scan's estimated footprint exceeds
     `spark_tpu.sql.memory.deviceBudget` (the planner-consulted memory
@@ -460,7 +502,8 @@ def try_stream_aggregate_spill(agg: "P.HashAggregateExec", conf,
     est_b = estimated_scan_bytes(leaf)
     if est_b is not None and est_b <= budget:
         return None
-    return stream_scan_aggregate_spill(agg, chain, leaf, conf, cache)
+    return stream_scan_aggregate_spill(agg, chain, leaf, conf, cache,
+                                       recovery)
 
 
 def _dict_growth_guard(agg: "P.HashAggregateExec", prep):
@@ -487,6 +530,84 @@ def _dict_growth_guard(agg: "P.HashAggregateExec", prep):
     return check_dicts
 
 
+def checkpoint_key(agg: "P.HashAggregateExec", leaf: P.ScanExec,
+                   chunk_rows: int) -> str:
+    """Plan-independent identity of a resumable stream: the mesh
+    partial aggregate that SAVES a checkpoint and the single-device
+    complete aggregate that RESTORES it are different physical nodes
+    from different plans, but stream the same source rows under the
+    same chunk boundaries into the same aggregation. Source identity
+    (cache token), pruned columns, pushed-filter count, group/agg
+    names and the chunk size pin all of that; any mismatch (e.g. the
+    OOM ladder shrank streamingChunkRows) makes the checkpoint
+    unmatchable and the fallback safely restarts from chunk 0."""
+    token = leaf.source.cache_token()
+    src = repr(token) if token is not None else f"name:{leaf.source.name}"
+    cols = sorted(leaf.required_columns or [])
+    # filter VALUES, not count: two same-shaped aggregates over the
+    # same source differing only in predicate literals must not share
+    # a checkpoint slot (name() renders literals: "(l_shipdate <= N)")
+    filters = sorted(f.name() for f in (leaf.pushed_filters or ()))
+    groups = [g.name() for g in agg.group_exprs]
+    aggs = [f"{type(a.func).__name__}:{a.out_name}" for a in agg.agg_exprs]
+    return (f"{src}|cols{cols}|f{filters}"
+            f"|g{groups}|a{aggs}|c{chunk_rows}")
+
+
+def _with_dict_overrides(batch: Batch, dict_overrides: dict) -> Batch:
+    """Swap grown global dictionaries into a partial/final batch's
+    dictionary-encoded columns (codes handed out earlier stay valid —
+    DictUnifier grows append-only)."""
+    if not dict_overrides:
+        return batch
+    cols = dict(batch.columns)
+    for name, dic in dict_overrides.items():
+        if name in cols and cols[name].dictionary is not None:
+            c = cols[name]
+            cols[name] = type(c)(c.data, c.dtype, c.validity, dic)
+    return Batch(cols, batch.selection)
+
+
+def resume_from_mesh_checkpoint(agg: "P.HashAggregateExec", conf,
+                                cache: Optional[dict] = None,
+                                recovery=None):
+    """Mesh-fallback restore: when the failed mesh stream left a
+    checkpoint matching this (single-device, complete-mode) aggregate,
+    resume at the checkpointed chunk cursor — stream the REMAINING
+    chunks through the partial-spill driver with the checkpointed
+    partial rows prepended, for the caller to re-reduce with a FINAL
+    aggregate. Returns (partial table, partial node) like
+    stream_scan_aggregate_spill, or None when no checkpoint applies."""
+    if recovery is None or not recovery.checkpoints:
+        return None
+    if agg.mode != "complete":
+        return None
+    if any(a.func.uses_row_base for a in agg.agg_exprs):
+        return None  # never checkpointed (position packing is per-run)
+    if any(getattr(a.func, "positional", False) for a in agg.agg_exprs):
+        return None
+    found = find_streamable_chain(agg)
+    if found is None:
+        return None
+    chain, leaf = found
+    if not isinstance(leaf, P.ScanExec) or \
+            not hasattr(leaf.source, "load_chunks"):
+        return None
+    chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
+    ck = recovery.get_checkpoint(checkpoint_key(agg, leaf, chunk_rows))
+    if ck is None:
+        return None
+    out = stream_scan_aggregate_spill(agg, chain, leaf, conf, cache,
+                                      recovery=recovery,
+                                      skip_chunks=ck.cursor,
+                                      seed_partials=[ck.table])
+    if out is None:
+        return None
+    recovery.record("checkpoint_restore", None, cursor=int(ck.cursor),
+                    ckpt_rows=int(ck.table.num_rows))
+    return out
+
+
 def _streamable_string_keys(agg, child_schema) -> bool:
     """Only bare string column references stream (their dictionary grows
     append-only via DictUnifier); derived string keys rebuild per-chunk
@@ -503,8 +624,8 @@ def _streamable_string_keys(agg, child_schema) -> bool:
 
 
 def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
-                               cache: Optional[dict] = None
-                               ) -> Optional[Batch]:
+                               cache: Optional[dict] = None,
+                               recovery=None) -> Optional[Batch]:
     """Chunked host ingest under a mesh: each chunk is sharded over the
     data axis and folded into PER-SHARD accumulator tables by a jitted
     shard_map step; the final step emits each shard's partial batch, so
@@ -610,23 +731,36 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
         chunk_base += padded.capacity
         return out
 
-    check_dicts(first)
-    tables = step(tables, first)
-    for b in chunks:
-        check_dicts(b)
-        tables = step(tables, b)
+    def current_dicts() -> dict:
+        return dict(chunks.dictionaries) if hasattr(
+            chunks, "dictionaries") else {}
 
-    dict_overrides = dict(chunks.dictionaries) if hasattr(
-        chunks, "dictionaries") else {}
-    batch = emit_step(tables)
-    if dict_overrides:
-        cols = dict(batch.columns)
-        for name, dic in dict_overrides.items():
-            if name in cols and cols[name].dictionary is not None:
-                c = cols[name]
-                cols[name] = type(c)(c.data, c.dtype, c.validity, dic)
-        batch = Batch(cols, batch.selection)
-    return batch
+    def snapshot():
+        # device->host checkpoint of the accumulator state: emit the
+        # per-shard partial rows (the exact shape a FINAL aggregate
+        # consumes) and decode them against the dictionaries grown so
+        # far — every code folded so far is covered (append-only)
+        return _with_dict_overrides(emit_step(tables),
+                                    current_dicts()).to_arrow()
+
+    # chunk-granular retry + periodic checkpoint (execution/recovery.py):
+    # position-packed aggregates are excluded from checkpointing — their
+    # packed row bases are per-run and would not merge with a resume
+    every = int(conf.get(CHECKPOINT_EVERY_KEY))
+    ck_key = checkpoint_key(agg, leaf, chunk_rows) \
+        if recovery is not None and every > 0 and not needs_base else None
+    retrier = ChunkRetrier(conf, recovery)
+    ci = 0
+    b = first
+    while b is not None:
+        check_dicts(b)
+        tables = retrier.run(lambda bb=b: step(tables, bb), chunk=ci)
+        ci += 1
+        if ck_key is not None and ci % every == 0:
+            recovery.save_checkpoint(ck_key, ci, snapshot)
+        b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
+
+    return _with_dict_overrides(emit_step(tables), current_dicts())
 
 
 def _prefer_resident(leaf: "P.ScanExec", conf) -> bool:
@@ -653,7 +787,8 @@ def _prefer_resident(leaf: "P.ScanExec", conf) -> bool:
 
 
 def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
-                         cache: Optional[dict] = None) -> Optional[Batch]:
+                         cache: Optional[dict] = None,
+                         recovery=None) -> Optional[Batch]:
     if agg.mode != "complete":
         return None
     if any(getattr(a.func, "positional", False) for a in agg.agg_exprs):
@@ -678,4 +813,4 @@ def try_stream_aggregate(agg: "P.HashAggregateExec", conf,
         return None
     if _prefer_resident(leaf, conf):
         return None
-    return stream_scan_aggregate(agg, chain, leaf, conf, cache)
+    return stream_scan_aggregate(agg, chain, leaf, conf, cache, recovery)

@@ -77,9 +77,12 @@ def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
 
 
 #: recovery-action counters an execution's fault_summary may carry
-#: (executor._record_fault actions + the aggregate backoff total)
+#: (executor._record_fault actions + the aggregate backoff total).
+#: chunk_retry / stage_reuse / checkpoint_restore are the
+#: partial-progress actions (execution/recovery.py).
 FAULT_ACTIONS = ("transient_retry", "stage_timeout", "oom_cache_evict",
-                 "oom_spill_reroute", "mesh_fallback")
+                 "oom_spill_reroute", "mesh_fallback", "chunk_retry",
+                 "stage_reuse", "checkpoint_restore")
 
 
 def fault_summary(events: pd.DataFrame) -> pd.DataFrame:
@@ -109,6 +112,10 @@ def fault_summary(events: pd.DataFrame) -> pd.DataFrame:
             row[a] = 0 if v is None else int(v)
         bk = acted.get("fault_retry_backoff_ms")
         row["retry_backoff_ms"] = 0.0 if bk is None else float(bk)
+        # events past the executor's 32-record cap are dropped from the
+        # nested list but COUNTED — nonzero means `events` is truncated
+        ed = acted.get("fault_events_dropped")
+        row["events_dropped"] = 0 if ed is None else int(ed)
         row["events"] = acted.get("fault_events") or []
         rows.append(row)
     return pd.DataFrame(rows)

@@ -194,6 +194,7 @@ class ChunkIterator:
         self._pending = []
         self._pending_rows = 0
         self._done = False
+        self._failed: Optional[BaseException] = None
         self._unifier = DictUnifier()
 
     @property
@@ -203,23 +204,59 @@ class ChunkIterator:
     def __iter__(self):
         return self
 
-    def __next__(self) -> Batch:
+    def _fill(self) -> None:
+        if self._failed is not None:
+            # the underlying reader raised mid-stream: a generator dies
+            # when an exception propagates through it, so continuing
+            # would silently truncate the stream to the buffered prefix
+            # (reading as end-of-stream). Poison the iterator instead —
+            # a per-chunk ingest retry re-raises the original error and
+            # the whole-query ladder restarts the stream fresh.
+            raise self._failed
         while not self._done and self._pending_rows < self._chunk_rows:
             try:
                 rb = next(self._batches)
             except StopIteration:
                 self._done = True
                 break
+            except Exception as e:
+                self._failed = e
+                raise
             self._pending.append(rb)
             self._pending_rows += rb.num_rows
+
+    def _take_chunk(self) -> Optional[pa.Table]:
+        """One chunk's Arrow slice off the stream (the shared cursor
+        advance of __next__ and skip_chunks, so both cut identical
+        chunk boundaries), or None at end of stream."""
+        self._fill()
         if self._pending_rows == 0:
-            raise StopIteration
+            return None
         table = pa.Table.from_batches(self._pending)
         take = min(self._pending_rows, self._chunk_rows)
         chunk = table.slice(0, take)
         rest = table.slice(take)
         self._pending = rest.to_batches() if rest.num_rows else []
         self._pending_rows = rest.num_rows
+        return chunk
+
+    def skip_chunks(self, n: int) -> int:
+        """Advance the cursor past the next `n` chunks without
+        dictionary-unifying or moving bytes to the device — the
+        checkpoint-restore path resumes a stream at a chunk cursor.
+        Returns how many chunks were actually skipped (fewer when the
+        stream ends first)."""
+        skipped = 0
+        while skipped < int(n):
+            if self._take_chunk() is None:
+                break
+            skipped += 1
+        return skipped
+
+    def __next__(self) -> Batch:
+        chunk = self._take_chunk()
+        if chunk is None:
+            raise StopIteration
         if self._capacity is None:
             from ..columnar import bucket_capacity
             self._capacity = bucket_capacity(self._chunk_rows)

@@ -14,7 +14,8 @@ Grammar (comma-separated rules):
 
     rule  := site ":" fault ":" nth [":" arg]
     site  := scan_load | stage_compile | stage_run | shuffle
-             | join_build | mesh   (KNOWN_SITES: the wired seams)
+             | join_build | mesh | stream_chunk | mesh_checkpoint
+             (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
     arg   := fault argument (only `slow`: sleep milliseconds, default 100)
@@ -35,7 +36,11 @@ Sites fire at Python execution time: host-side sites (scan_load,
 stage_run) fire on every pass; in-trace sites (shuffle, join_build) fire
 at TRACE time, i.e. once per (re)compile of the enclosing stage — the
 executor drops the failed stage's compiled entry on retry, so the retry
-re-traces and the site counts deterministically.
+re-traces and the site counts deterministically. `stream_chunk` fires
+once per chunk ATTEMPT inside the streaming drivers' chunk loops
+(execution/recovery.py, so replays re-fire and later hits can target
+retries); `mesh_checkpoint` fires at each mesh-stream snapshot point,
+before the snapshot is taken.
 """
 
 from __future__ import annotations
@@ -53,7 +58,7 @@ INJECT_KEY = "spark_tpu.faults.inject"
 #: set at ARM time — a typo'd site (`stage_rnu`) used to parse fine and
 #: then silently never fire, so the chaos test tested nothing.
 KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
-               "join_build", "mesh")
+               "join_build", "mesh", "stream_chunk", "mesh_checkpoint")
 
 #: test-registered extra seams (register_site): code under test may
 #: plant its own fire() points without editing the built-in tuple

@@ -238,8 +238,8 @@ def test_streamed_join_aggregate(session):
     engaged = []
     orig = SA.stream_scan_aggregate
 
-    def spy(agg, chain, leaf, conf, cache=None):
-        out = orig(agg, chain, leaf, conf, cache)
+    def spy(agg, chain, leaf, conf, cache=None, recovery=None):
+        out = orig(agg, chain, leaf, conf, cache, recovery)
         engaged.append((out is not None,
                         sum(1 for op in chain
                             if hasattr(op, "left_keys"))))

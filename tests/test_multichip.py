@@ -391,8 +391,8 @@ def test_distributed_streaming_aggregate(session):
     calls = []
     orig = SA.stream_scan_aggregate_mesh
 
-    def spy(agg, mesh, conf, cache=None):
-        out = orig(agg, mesh, conf, cache)
+    def spy(agg, mesh, conf, cache=None, recovery=None):
+        out = orig(agg, mesh, conf, cache, recovery)
         calls.append(out is not None)
         return out
 

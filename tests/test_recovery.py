@@ -1,0 +1,552 @@
+"""Partial-progress recovery suite (spark_tpu/execution/recovery.py):
+chunk-granular retry inside the streaming drivers, stage-output reuse
+across recovery loops, and mesh checkpoint/restore.
+
+The acceptance bar (ISSUE 5): with a `stream_chunk` fault injected at
+chunk k, metrics must prove the stream RESUMED (at most one chunk
+replayed, not k+1 — `rec_chunks_replayed` / `chunk_retry`), and
+Q1/Q3 results must match the no-fault goldens on the streaming, spill
+and mesh driver paths."""
+
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+from spark_tpu.testing import faults
+from spark_tpu.testing.faults import FaultInjected
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+SF = 0.002
+CHUNK_KEY = "spark_tpu.sql.execution.streamingChunkRows"
+CACHE_KEY = "spark_tpu.sql.io.deviceCacheBytes"
+BUDGET_KEY = "spark_tpu.sql.memory.deviceBudget"
+MESH_KEY = "spark_tpu.sql.mesh.size"
+DOMAIN_KEY = "spark_tpu.sql.aggregate.maxDirectDomain"
+RETRY_ON_KEY = "spark_tpu.execution.chunkRetry.enabled"
+RETRY_MAX_KEY = "spark_tpu.execution.chunkRetry.maxRetries"
+CKPT_KEY = "spark_tpu.execution.checkpoint.everyChunks"
+
+
+@pytest.fixture(scope="session")
+def tpch_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tpch_recovery") / "sf_small")
+    write_parquet(path, SF)
+    return path
+
+
+@pytest.fixture(scope="session")
+def tpch_session(session, tpch_path):
+    Q.register_tables(session, tpch_path)
+    return session
+
+
+@pytest.fixture(autouse=True)
+def streaming_conf(tpch_session):
+    """Chunked streaming on every query (small chunks, device-table
+    cache off so _prefer_resident can't bypass the drivers),
+    millisecond backoffs, disarmed plan. The conftest conf guard
+    restores every key afterwards."""
+    conf = tpch_session.conf
+    conf.set("spark_tpu.execution.backoffMs", 1)
+    conf.set(CHUNK_KEY, 1024)  # lineitem@SF0.002 ~ 12k rows -> ~12 chunks
+    conf.set(CACHE_KEY, 0)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _cold(session):
+    from spark_tpu.io.device_cache import CACHE
+    session._stage_cache.clear()
+    session._aqe_caps.clear()
+    CACHE.clear()
+
+
+def _run_query(session, qname):
+    df = Q.QUERIES[qname](session)
+    qe = df._qe()
+    table = qe.collect()
+    got = G.normalize_decimals(table.to_pandas()).reset_index(drop=True)
+    return got, qe
+
+
+def _check_golden(got, tpch_path, qname):
+    G.compare(got, G.GOLDEN[qname](tpch_path))
+
+
+def _replayed(session):
+    return session.metrics.counter("rec_chunks_replayed").value
+
+
+# -- chunk-granular retry: all three driver paths ----------------------------
+
+#: (id, qname, extra conf) — which streaming driver carries the query:
+#: q1 takes the direct accumulator-carry path; deviceBudget=1 pushes
+#: q3 (unbounded l_orderkey keys) and q1 (direct domain collapsed)
+#: through the partial-spill path; mesh.size=8 puts q1 on the sharded
+#: mesh streaming driver.
+_PATHS = [
+    ("streaming", "q1", {}),
+    ("spill", "q1", {BUDGET_KEY: 1, DOMAIN_KEY: 1}),
+    ("spill", "q3", {BUDGET_KEY: 1}),
+    ("mesh", "q1", {MESH_KEY: 8}),
+]
+
+
+@pytest.mark.parametrize("path_id,qname,extra",
+                         _PATHS, ids=[p[0] + "-" + p[1] for p in _PATHS])
+def test_chunk_retry_replays_one_chunk(tpch_session, tpch_path, path_id,
+                                       qname, extra):
+    """A transient fault at chunk k replays ONLY chunk k: golden
+    parity, exactly one chunk_retry action, rec_chunks_replayed grows
+    by one, and the whole-query retry loop is never consulted."""
+    _cold(tpch_session)
+    for k, v in extra.items():
+        tpch_session.conf.set(k, v)
+    before = _replayed(tpch_session)
+    with faults.inject(tpch_session.conf,
+                       "stream_chunk:unavailable:3") as plan:
+        got, qe = _run_query(tpch_session, qname)
+        assert plan.fired_log == [("stream_chunk", 3, "unavailable")]
+        assert plan.hits["stream_chunk"] > 3, \
+            "stream produced too few chunks — scenario is near-vacuous"
+    assert qe.fault_summary.get("chunk_retry") == 1, qe.fault_summary
+    # the stream RESUMED: one replay, not a restart (no transient_retry,
+    # no second pass over chunks 0..k-1)
+    assert _replayed(tpch_session) - before == 1
+    assert "transient_retry" not in qe.fault_summary, qe.fault_summary
+    _check_golden(got, tpch_path, qname)
+
+
+def test_chunk_retry_budget_per_chunk(tpch_session, tpch_path):
+    """Two faults on DIFFERENT chunks both recover: the retry budget is
+    per chunk (spark.task.maxFailures style), not per stream."""
+    _cold(tpch_session)
+    before = _replayed(tpch_session)
+    with faults.inject(tpch_session.conf,
+                       "stream_chunk:unavailable:2,"
+                       "stream_chunk:unavailable:6") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert len(plan.fired_log) == 2
+    assert qe.fault_summary.get("chunk_retry") == 2, qe.fault_summary
+    assert _replayed(tpch_session) - before == 2
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_chunk_retry_consecutive_hits_same_chunk(tpch_session, tpch_path):
+    """A replay re-fires the seam, so back-to-back rules model a chunk
+    that fails twice before succeeding — still within the per-chunk
+    budget (maxRetries default 2)."""
+    _cold(tpch_session)
+    with faults.inject(tpch_session.conf,
+                       "stream_chunk:unavailable:3,"
+                       "stream_chunk:unavailable:4") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert len(plan.fired_log) == 2
+    assert qe.fault_summary.get("chunk_retry") == 2, qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_chunk_retry_disabled_falls_back_to_stream_restart(tpch_session,
+                                                           tpch_path):
+    """chunkRetry.enabled=false restores PR-2 granularity: the fault
+    surfaces to the whole-query ladder, which restarts the stream
+    (transient_retry, no chunk_retry) — and still reaches parity."""
+    _cold(tpch_session)
+    tpch_session.conf.set(RETRY_ON_KEY, False)
+    with faults.inject(tpch_session.conf,
+                       "stream_chunk:unavailable:3") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert plan.fired_log, "fault never fired — scenario is vacuous"
+    assert "chunk_retry" not in qe.fault_summary, qe.fault_summary
+    assert qe.fault_summary.get("transient_retry", 0) >= 1, qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_chunk_retry_zero_budget_behaves_disabled(tpch_session, tpch_path):
+    _cold(tpch_session)
+    tpch_session.conf.set(RETRY_MAX_KEY, 0)
+    with faults.inject(tpch_session.conf, "stream_chunk:unavailable:2"):
+        got, qe = _run_query(tpch_session, "q1")
+    assert "chunk_retry" not in qe.fault_summary
+    assert qe.fault_summary.get("transient_retry", 0) >= 1
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_chunk_retry_fatal_not_absorbed(tpch_session):
+    """Chunk retry only covers TRANSIENT/TIMEOUT: a fatal fault inside
+    the chunk loop surfaces unchanged."""
+    _cold(tpch_session)
+    with faults.inject(tpch_session.conf, "stream_chunk:fatal:2"):
+        with pytest.raises(FaultInjected, match="INTERNAL"):
+            _run_query(tpch_session, "q1")
+
+
+def test_chunk_retry_external_collect(tpch_session, tpch_path):
+    """The out-of-core host-egress path (execution/external.py) rides
+    the same per-chunk retry: ORDER BY over a scan past the device
+    budget recovers a mid-stream flake chunk-wise."""
+    import pandas as pd
+    _cold(tpch_session)
+    conf = tpch_session.conf
+    conf.set(BUDGET_KEY, 1)
+    df = tpch_session.table("lineitem") \
+        .select(col("l_orderkey"), col("l_quantity")) \
+        .order_by(col("l_orderkey"))
+    before = _replayed(tpch_session)
+    with faults.inject(conf, "stream_chunk:unavailable:2") as plan:
+        qe = df._qe()
+        got = qe.collect().to_pandas()
+        assert plan.fired_log, "external stream never chunked — vacuous"
+    assert qe.fault_summary.get("chunk_retry") == 1, qe.fault_summary
+    assert _replayed(tpch_session) - before == 1
+    want = pd.read_parquet(tpch_path + "/lineitem.parquet")[
+        ["l_orderkey", "l_quantity"]].sort_values(
+        "l_orderkey", kind="stable").reset_index(drop=True)
+    assert got["l_orderkey"].tolist() == want["l_orderkey"].tolist()
+    assert float(got["l_quantity"].sum()) == pytest.approx(
+        float(want["l_quantity"].sum()))
+
+
+# -- stage-output reuse across recovery loops --------------------------------
+
+def test_stage_reuse_upstream_runs_once(tpch_session, tpch_path,
+                                        monkeypatch):
+    """The surviving-shuffle-file analog: a transient fault in the
+    DOWNSTREAM final stage re-executes the query, but the completed
+    streamed-aggregate stage (and its join build sides) replay from
+    the stage-output memo — the spill driver runs exactly once."""
+    import spark_tpu.execution.streaming_agg as SA
+    _cold(tpch_session)
+    tpch_session.conf.set(BUDGET_KEY, 1)
+    calls = []
+    orig = SA.try_stream_aggregate_spill
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(SA, "try_stream_aggregate_spill", counting)
+    reused0 = tpch_session.metrics.counter("rec_stages_reused").value
+    with faults.inject(tpch_session.conf,
+                       "stage_run:unavailable:1") as plan:
+        got, qe = _run_query(tpch_session, "q3")
+        assert plan.fired_log == [("stage_run", 1, "unavailable")]
+    assert len(calls) == 1, "upstream stream re-ran despite the memo"
+    assert qe.fault_summary.get("transient_retry", 0) >= 1
+    assert qe.fault_summary.get("stage_reuse", 0) >= 1, qe.fault_summary
+    assert tpch_session.metrics.counter(
+        "rec_stages_reused").value - reused0 >= 1
+    _check_golden(got, tpch_path, "q3")
+
+
+def test_stage_reuse_counted_once_per_attempt():
+    """A re-execution may consult the same memo entry several times
+    (direct probe, then spill fallback): that is ONE reused stage, not
+    several — but a LATER recovery attempt counts it again."""
+    from spark_tpu.execution.recovery import RecoveryContext
+    recorded = []
+    rc = RecoveryContext(record=lambda a, e=None, **kw: recorded.append(a))
+    rc.memo_put(("build", 1), "b")
+    assert rc.memo_get(("build", 1)) == "b"
+    assert recorded == []  # pre-failure dedup: not a recovery action
+    rc.begin_recovery_attempt()
+    assert rc.memo_get(("build", 1)) == "b"
+    assert rc.memo_get(("build", 1)) == "b"  # same attempt: one record
+    assert recorded == ["stage_reuse"]
+    rc.begin_recovery_attempt()
+    assert rc.memo_get(("build", 1)) == "b"  # next attempt counts again
+    assert recorded == ["stage_reuse", "stage_reuse"]
+
+
+def test_oom_evicts_memoized_stage_outputs(tpch_session, tpch_path):
+    """OOM rung 1 evicts the storage pool — including memoized stage
+    outputs, which pin device batches: the retry must re-run the
+    stream unpinned (no stage_reuse), and still reach parity."""
+    _cold(tpch_session)
+    tpch_session.conf.set(BUDGET_KEY, 1)
+    with faults.inject(tpch_session.conf,
+                       "stage_run:resource_exhausted:1") as plan:
+        got, qe = _run_query(tpch_session, "q3")
+        assert plan.fired_log, "OOM never fired — scenario is vacuous"
+    assert qe.fault_summary.get("oom_cache_evict", 0) >= 1
+    assert "stage_reuse" not in qe.fault_summary, qe.fault_summary
+    _check_golden(got, tpch_path, "q3")
+
+
+def test_external_collect_exhausted_chunk_budget_restarts_stream(
+        tpch_session):
+    """When a chunk burns its whole per-chunk budget on the external
+    path, the failure surfaces to a whole-stream transient rung (the
+    documented fallback) instead of aborting collect()."""
+    _cold(tpch_session)
+    conf = tpch_session.conf
+    conf.set(BUDGET_KEY, 1)
+    # per-chunk budget is 2: hits 1,2,3 exhaust chunk 0's retries; the
+    # stream restart then passes (every rule already fired)
+    spec = ",".join(f"stream_chunk:unavailable:{n}" for n in (1, 2, 3))
+    df = tpch_session.table("lineitem") \
+        .select(col("l_orderkey")).order_by(col("l_orderkey"))
+    with faults.inject(conf, spec) as plan:
+        qe = df._qe()
+        got = qe.collect()
+        assert len(plan.fired_log) == 3
+    assert qe.fault_summary.get("chunk_retry", 0) == 2, qe.fault_summary
+    assert qe.fault_summary.get("transient_retry", 0) == 1, qe.fault_summary
+    keys = got.column("l_orderkey").to_pylist()
+    assert keys == sorted(keys)  # complete, ordered result
+    want_rows = len(tpch_session.table("lineitem").to_pandas())
+    assert got.num_rows == want_rows
+
+
+def test_stage_reuse_invalidated_on_spill_replan(tpch_session, tpch_path):
+    """The OOM ladder's rung-2 deviceBudget re-plan changes streaming
+    shapes: memoized outputs must NOT splice into the new plan. Two
+    OOMs descend to the reroute; the rerouted run must still hit
+    parity (a stale splice would not)."""
+    _cold(tpch_session)
+    spec = "stage_run:resource_exhausted:1,stage_run:resource_exhausted:2"
+    with faults.inject(tpch_session.conf, spec):
+        got, qe = _run_query(tpch_session, "q1")
+    assert qe.fault_summary.get("oom_spill_reroute", 0) >= 1
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_clean_run_records_no_recovery_actions(tpch_session, tpch_path):
+    """Noise gate: streaming with every recovery feature armed but no
+    faults records NOTHING in fault_summary (memo fills, checkpoints
+    save — neither is a recovery action)."""
+    _cold(tpch_session)
+    tpch_session.conf.set(MESH_KEY, 8)
+    tpch_session.conf.set(CKPT_KEY, 2)
+    ckpt0 = tpch_session.metrics.counter("rec_ckpt_bytes").value
+    got, qe = _run_query(tpch_session, "q1")
+    assert qe.fault_summary == {}, qe.fault_summary
+    # ...but the checkpoints were really taken
+    assert tpch_session.metrics.counter("rec_ckpt_bytes").value > ckpt0
+    _check_golden(got, tpch_path, "q1")
+
+
+# -- mesh checkpoint/restore -------------------------------------------------
+
+def test_checkpoint_restore_resumes_at_cursor(tpch_session, tpch_path):
+    """A mesh host lost at the 2nd snapshot point: the single-device
+    fallback hands the chunk-2 checkpoint to the resumed stream, which
+    skips the checkpointed chunks instead of restarting at chunk 0 —
+    and the merged result is golden-identical."""
+    _cold(tpch_session)
+    conf = tpch_session.conf
+    conf.set(MESH_KEY, 8)
+    conf.set(CKPT_KEY, 2)
+    ckpt0 = tpch_session.metrics.counter("rec_ckpt_bytes").value
+    with faults.inject(conf, "mesh_checkpoint:fatal:2") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert plan.fired_log == [("mesh_checkpoint", 2, "fatal")]
+    assert qe.fault_summary.get("mesh_fallback") == 1, qe.fault_summary
+    assert qe.fault_summary.get("checkpoint_restore") == 1, qe.fault_summary
+    restore = next(ev for ev in qe.fault_events
+                   if ev["action"] == "checkpoint_restore")
+    assert restore["cursor"] == 2  # resumed at the snapshot, not chunk 0
+    assert restore["ckpt_rows"] > 0
+    assert tpch_session.metrics.counter("rec_ckpt_bytes").value > ckpt0
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_checkpoint_disabled_fallback_restarts(tpch_session, tpch_path):
+    """checkpoint.everyChunks=0: a mid-stream mesh loss falls back
+    single-device WITHOUT a restore (PR-2 behavior) — parity via full
+    restart. The mesh_checkpoint seam never fires, so the fault rides
+    the mesh site at compile instead."""
+    _cold(tpch_session)
+    conf = tpch_session.conf
+    conf.set(MESH_KEY, 8)
+    conf.set(CKPT_KEY, 0)
+    with faults.inject(conf, "mesh:fatal:1") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert plan.fired_log == [("mesh", 1, "fatal")]
+    assert qe.fault_summary.get("mesh_fallback") == 1
+    assert "checkpoint_restore" not in qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_checkpoint_lost_before_first_snapshot_restarts(tpch_session,
+                                                        tpch_path):
+    """A mesh lost AT the first snapshot attempt has no checkpoint to
+    resume from: the fallback must restart from chunk 0 (no
+    checkpoint_restore) and still reach parity."""
+    _cold(tpch_session)
+    conf = tpch_session.conf
+    conf.set(MESH_KEY, 8)
+    conf.set(CKPT_KEY, 3)
+    with faults.inject(conf, "mesh_checkpoint:fatal:1") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert plan.fired_log == [("mesh_checkpoint", 1, "fatal")]
+    assert qe.fault_summary.get("mesh_fallback") == 1
+    assert "checkpoint_restore" not in qe.fault_summary, qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_checkpoint_chunk_size_mismatch_ignored(tpch_session, tpch_path):
+    """A checkpoint keyed under different chunk boundaries (e.g. the
+    OOM ladder shrank streamingChunkRows between save and restore)
+    must not restore — checkpoint_key pins the chunk size, so the
+    fallback safely restarts from chunk 0."""
+    _cold(tpch_session)
+    conf = tpch_session.conf
+    conf.set(MESH_KEY, 8)
+    conf.set(CKPT_KEY, 2)
+
+    # fail at the 3rd snapshot, then shrink the chunk size for the
+    # fallback via a conf the restore path reads at resume time
+    from spark_tpu.execution import executor as EX
+    orig = EX.QueryExecution._handle_failure
+
+    def shrink_then_handle(self, e):
+        conf.set(CHUNK_KEY, 512)  # fallback streams different chunks
+        return orig(self, e)
+
+    EX.QueryExecution._handle_failure = shrink_then_handle
+    try:
+        with faults.inject(conf, "mesh_checkpoint:fatal:3") as plan:
+            got, qe = _run_query(tpch_session, "q1")
+            assert plan.fired_log == [("mesh_checkpoint", 3, "fatal")]
+    finally:
+        EX.QueryExecution._handle_failure = orig
+    assert qe.fault_summary.get("mesh_fallback") == 1
+    assert "checkpoint_restore" not in qe.fault_summary, qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_checkpoint_key_distinguishes_filter_values(tpch_session):
+    """Two same-shaped aggregates over the same source differing only
+    in predicate literals must not share a checkpoint slot (a restore
+    seeded from the other stream's partials would be silently wrong)."""
+    from spark_tpu.execution.streaming_agg import checkpoint_key
+    from spark_tpu.plan import physical as P
+
+    def leaf_of(df):
+        qe = df._qe()
+        out = []
+
+        def walk(n):
+            if isinstance(n, P.ScanExec):
+                out.append(n)
+            for c in n.children:
+                walk(c)
+
+        walk(qe.executed_plan)
+        agg = [n for n in _iter_nodes(qe.executed_plan)
+               if isinstance(n, P.HashAggregateExec)][0]
+        return agg, out[0]
+
+    def _iter_nodes(n):
+        yield n
+        for c in n.children:
+            yield from _iter_nodes(c)
+
+    base = tpch_session.table("lineitem")
+    a1, l1 = leaf_of(base.filter(col("l_quantity") < 10).agg(
+        F.sum(col("l_quantity")).alias("s")))
+    a2, l2 = leaf_of(base.filter(col("l_quantity") < 20).agg(
+        F.sum(col("l_quantity")).alias("s")))
+    assert checkpoint_key(a1, l1, 1024) != checkpoint_key(a2, l2, 1024)
+    # and the same plan produces the same key (save/restore must match)
+    a3, l3 = leaf_of(base.filter(col("l_quantity") < 10).agg(
+        F.sum(col("l_quantity")).alias("s")))
+    assert checkpoint_key(a1, l1, 1024) == checkpoint_key(a3, l3, 1024)
+
+
+def test_ingest_reader_failure_never_truncates(tpch_session):
+    """A mid-stream failure of the UNDERLYING batch reader (a
+    generator-backed scanner) kills the generator; retrying next()
+    would read the dead reader as end-of-stream and silently aggregate
+    a prefix. The iterator poisons itself instead: the per-chunk retry
+    re-raises, the whole-query ladder restarts the stream fresh, and
+    the result is complete."""
+    import pyarrow as pa
+    from spark_tpu.io.sources import ArrowTableSource, ChunkIterator
+
+    table = pa.table({"v": list(range(10000))})
+    fails = [True]  # the reader dies once, mid-stream, per process
+
+    class FlakyOnceSource(ArrowTableSource):
+        def load_chunks(self, required_columns, pushed_filters,
+                        chunk_rows):
+            def batches():
+                for i, rb in enumerate(self.table.to_batches(
+                        max_chunksize=1024)):
+                    if i == 3 and fails[0]:
+                        fails[0] = False
+                        raise RuntimeError(
+                            "UNAVAILABLE: reader connection reset")
+                    yield rb
+            return ChunkIterator(batches(), chunk_rows)
+
+    tpch_session.register_table("flaky_t", FlakyOnceSource("flaky_t",
+                                                           table))
+    df = tpch_session.table("flaky_t").group_by(
+        (col("v") % 7).alias("k")).agg(F.sum(col("v")).alias("s"))
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the transient-retry warning
+        got = df.to_pandas().sort_values("k").reset_index(drop=True)
+    assert not fails[0], "reader never failed — scenario is vacuous"
+    # complete result — NOT the 3-batch prefix the dead reader buffered
+    assert int(got["s"].sum()) == sum(range(10000))
+
+
+# -- event-log / history observability ---------------------------------------
+
+def test_recovery_actions_reach_history(tpch_session, tpch_path, tmp_path):
+    from spark_tpu import history
+    _cold(tpch_session)
+    log_dir = str(tmp_path / "events")
+    conf = tpch_session.conf
+    conf.set("spark_tpu.sql.eventLog.dir", log_dir)
+    try:
+        with faults.inject(conf, "stream_chunk:unavailable:2"):
+            got, qe = _run_query(tpch_session, "q1")
+    finally:
+        conf.set("spark_tpu.sql.eventLog.dir", "")
+    _check_golden(got, tpch_path, "q1")
+    events = history.read_event_log(log_dir)
+    summary = history.fault_summary(events)
+    assert len(summary) >= 1
+    row = summary.iloc[-1]
+    assert row["chunk_retry"] == 1
+    assert row["events_dropped"] == 0
+    assert any(ev.get("action") == "chunk_retry" and "chunk" in ev
+               for ev in row["events"])
+
+
+# -- satellite bugfixes ------------------------------------------------------
+
+def test_fault_events_cap_counts_drops(tpch_session):
+    """executor._record_fault caps the event list at 32; overflow used
+    to vanish silently — now fault_summary carries events_dropped."""
+    qe = tpch_session.range(10)._qe()
+    for i in range(40):
+        qe._record_fault("transient_retry", RuntimeError(f"e{i}"))
+    assert len(qe.fault_events) == 32
+    assert qe.fault_summary["transient_retry"] == 40
+    assert qe.fault_summary["events_dropped"] == 8
+
+
+def test_recovery_nonconvergence_diagnostic(tpch_session):
+    """_execute_recover's 32-action bound used to raise a bare
+    RuntimeError; the message now carries the accumulated fault_summary
+    and the last error, so a non-converging recovery is diagnosable."""
+    qe = tpch_session.range(10)._qe()
+    qe.fault_summary = {"transient_retry": 5}
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE: flaky backend endpoint")
+
+    qe._execute_batch_inner = boom
+    qe._handle_failure = lambda e: None  # pretend every action applies
+    with pytest.raises(RuntimeError, match="did not converge") as ei:
+        qe._execute_recover()
+    msg = str(ei.value)
+    assert "transient_retry" in msg and "flaky backend endpoint" in msg
