@@ -23,6 +23,16 @@ deadline, AND the aggregate summary line {"metric", "value", "unit",
 section — a killed or hung run leaves both per-section lines and a
 parseable partial summary. Consumers take the LAST summary-shaped line;
 the final rewrite drops the partial marker.
+
+Round-5 post-mortem (rc:124, parsed:null): per-section budgets of 900s
+x 5 sections + 1650s of SF10 never fit the driver's outer `timeout`, so
+the kill arrived with nothing parseable emitted. The matrix now fits a
+TOTAL budget (`BENCH_TOTAL_BUDGET_S`, default 2400s) enforced on top of
+tighter per-section deadlines (`BENCH_SECTION_BUDGET_S`, default 420s):
+each section gets min(section budget, remaining total), sections past
+the total are SKIPPED with their own JSON line, and the SF10 sweep is
+opt-in (`BENCH_RUN_SF10=1`) instead of default — the default matrix
+completes inside the budget with a final (non-partial) summary.
 """
 
 import contextlib
@@ -351,7 +361,25 @@ def main():
     from spark_tpu import SparkTpuSession
 
     spark = SparkTpuSession.builder().get_or_create()
-    budget = float(os.environ.get("BENCH_SECTION_BUDGET_S", "900"))
+    budget = float(os.environ.get("BENCH_SECTION_BUDGET_S", "420"))
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2400"))
+    t_run0 = time.perf_counter()
+
+    def remaining() -> float:
+        return total_budget - (time.perf_counter() - t_run0)
+
+    def run_budgeted(name: str, fn, want_s: float) -> dict:
+        """_run_section under the TOTAL budget: a section whose slice
+        has shrunk below 30s is skipped (with its own JSON line) so the
+        run always reaches the final summary rewrite inside the
+        driver's outer timeout."""
+        left = remaining()
+        if left < 30:
+            data = {f"{name}_skipped": f"total budget "
+                                       f"({total_budget:g}s) exhausted"}
+            _emit(name, "skipped", time.perf_counter(), data)
+            return data
+        return _run_section(name, fn, min(want_s, left))
 
     # The aggregate summary is REWRITTEN (one flushed JSON line, marked
     # "partial": true) after EVERY section, so a global `timeout` kill
@@ -367,7 +395,7 @@ def main():
         out = summary if final else dict(summary, partial=True)
         print(json.dumps(out), flush=True)
 
-    keys = _run_section(
+    keys = run_budgeted(
         "linear_keys",
         lambda: {"keys_rows_per_sec_M":
                  round(bench_linear_keys(spark) / 1e6, 1)},
@@ -385,29 +413,37 @@ def main():
         return {"stddev_rows_per_sec_M": round(rps / 1e6, 1),
                 "stddev_vs_baseline": round(rps / STDDEV_BASELINE, 3)}
 
-    extra.update(_run_section("stddev", stddev_section, budget))
+    extra.update(run_budgeted("stddev", stddev_section, budget))
     emit_summary()
-    extra.update(_run_section(
+    extra.update(run_budgeted(
         "grouped100",
         lambda: {"grouped100_rows_per_sec_M":
                  round(bench_100_groups(spark) / 1e6, 1)},
         budget))
     emit_summary()
-    extra.update(_run_section(
+    extra.update(run_budgeted(
         "kernel_pick", lambda: bench_kernel_pick(spark), budget))
     emit_summary()
-    extra.update(_run_section(
+    # the TPC-H trajectory is the headline consumer of BENCH rounds:
+    # give it whatever remains of the total budget (at least its
+    # section slice) so earlier overruns can't starve it entirely
+    tpch_budget = max(budget, min(2 * budget, remaining() - 30))
+    extra.update(run_budgeted(
         f"tpch_sf{TPCH_SF:g}",
         lambda: bench_tpch(
             spark, TPCH_SF, TPCH_PATH,
-            deadline=time.perf_counter() + budget * 0.9),
-        budget))
+            deadline=time.perf_counter()
+            + min(tpch_budget, max(remaining(), 1)) * 0.9),
+        tpch_budget))
     emit_summary()
 
     # SF10: the north-star scale on one chip (VERDICT r4 #2). The
     # device-table cache budget rises so the pruned lineitem goes
     # RESIDENT (~3.6GB in 16GB HBM): warm runs then skip host ingest.
-    if not os.environ.get("BENCH_SKIP_SF10"):
+    # Opt-in (BENCH_RUN_SF10=1): the default matrix must fit the total
+    # budget, and r05 proved the SF10 sweep alone can blow it.
+    if os.environ.get("BENCH_RUN_SF10") \
+            and not os.environ.get("BENCH_SKIP_SF10"):
         sf10_path = os.path.join(os.path.dirname(
             os.path.abspath(__file__)), "data", "tpch", "sf10")
         sf10_budget = float(os.environ.get("BENCH_SF10_BUDGET_S", "1500"))
@@ -422,7 +458,7 @@ def main():
                 spark.conf.set("spark_tpu.sql.io.deviceCacheBytes",
                                6 << 30)
 
-        extra.update(_run_section("tpch_sf10", sf10_section,
+        extra.update(run_budgeted("tpch_sf10", sf10_section,
                                   sf10_budget * 1.1))
 
     emit_summary(final=True)

@@ -4,13 +4,16 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Six stages, all mandatory:
+# Seven stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
-#   3. bench smoke: the headline aggregate shape at a reduced size, so
-#      the bench entrypoint itself (imports, section harness, JSON
-#      emission) is known-runnable before the driver spends a TPU slot
+#   3. bench smoke + perf-regression gate: the headline aggregate
+#      shape at a reduced size (bench entrypoint known-runnable before
+#      the driver spends a TPU slot), then scripts/perf_gate.py runs
+#      the TPC-H Q1/Q3 smoke and FAILS on >25% tpch_*_ms regression
+#      against the recorded platform baseline (PERF_BASELINE.json,
+#      seeded from the last good BENCH_*.json on TPU)
 #   4. chaos smoke: one injected OOM + one injected transient against
 #      TPC-H Q1 with golden parity — the failure-recovery ladder
 #      (executor taxonomy + fault injection) must survive end-to-end —
@@ -30,6 +33,10 @@
 #      (scripts/lint.py --all — metric prefixes, conf-key
 #      registration, fault-site wiring, tracer-leak shapes; absorbs
 #      the former metrics-lint stage)
+#   7. service smoke: start the SQL service (spark_tpu/service/) on an
+#      ephemeral port, POST TPC-H Q1 over HTTP, assert golden parity
+#      of the JSON result, that GET /metrics parses as Prometheus
+#      text exposition, and a clean shutdown
 #
 # Usage: scripts/preflight.sh [--fast]
 #   --fast skips the full pytest suite (stages 2-6 still run) for quick
@@ -44,7 +51,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/6: tier-1 test suite --"
+    echo "-- stage 1/7: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -58,16 +65,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/6: SKIPPED (--fast) --"
+    echo "-- stage 1/7: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/6: dryrun_multichip(8) --"
+echo "-- stage 2/7: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/6: bench smoke --"
+echo "-- stage 3/7: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -94,7 +101,12 @@ assert out.get("groups") == 256, out
 print(json.dumps({"preflight_bench_smoke": "ok"}))
 EOF
 
-echo "-- stage 4/6: chaos smoke --"
+# perf-regression gate: TPC-H Q1/Q3 smoke vs the recorded platform
+# baseline; >25% tpch_*_ms regression fails the preflight (recalibrate
+# deliberate changes with scripts/perf_gate.py --update)
+env JAX_PLATFORMS=cpu python scripts/perf_gate.py
+
+echo "-- stage 4/7: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -148,7 +160,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/6: observability + analysis smoke --"
+echo "-- stage 5/7: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -210,7 +222,61 @@ print(json.dumps({"preflight_observability_smoke": "ok",
                   "trace_events": len(t["traceEvents"])}))
 EOF2
 
-echo "-- stage 6/6: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/7: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
+
+echo "-- stage 7/7: SQL service smoke --"
+# Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
+# over HTTP, check golden parity of the JSON rows, scrape-parse
+# GET /metrics, then shut down cleanly.
+env JAX_PLATFORMS=cpu python - <<'EOF3'
+import json
+import tempfile
+import urllib.request
+
+import pandas as pd
+
+from spark_tpu import Conf
+from spark_tpu.observability.metrics import parse_prometheus_text
+from spark_tpu.service.server import SqlService
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch import sql_queries as SQLQ
+from spark_tpu.tpch.datagen import write_parquet
+
+path = tempfile.mkdtemp(prefix="preflight_service_") + "/sf"
+write_parquet(path, 0.001)
+
+conf = Conf()
+conf.set("spark_tpu.service.port", 0)
+svc = SqlService(conf,
+                 init_session=lambda s: Q.register_tables(s, path)).start()
+try:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}/sql",
+        data=json.dumps({"sql": SQLQ.Q1}).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = json.load(urllib.request.urlopen(req, timeout=300))
+    assert resp["status"] == "ok", resp
+    got = pd.DataFrame(resp["rows"], columns=resp["columns"])
+    want = G.GOLDEN["q1"](path)
+    G.compare(G.normalize_decimals(got)[list(want.columns)]
+              .reset_index(drop=True), want.reset_index(drop=True))
+    # structured status record fed by the listener bus
+    rec = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{svc.port}/queries/{resp['query_id']}",
+        timeout=30))
+    assert rec["status"] == "ok" and rec["engine_query_id"] >= 1, rec
+    # live Prometheus exposition parses
+    prom = parse_prometheus_text(urllib.request.urlopen(
+        f"http://127.0.0.1:{svc.port}/metrics", timeout=30)
+        .read().decode())
+    assert prom.get("spark_tpu_service_completed", 0) >= 1, prom
+    assert prom.get("spark_tpu_queries_total", 0) >= 1, prom
+finally:
+    svc.stop()
+print(json.dumps({"preflight_service_smoke": "ok",
+                  "rows": int(resp["row_count"])}))
+EOF3
 
 echo "== preflight PASSED =="

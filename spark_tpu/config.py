@@ -447,6 +447,79 @@ CLUSTER_PROCESS_ID = register(
     "spark_tpu.sql.cluster.processId", 0,
     doc="This process's rank within the multi-host cluster.")
 
+SERVICE_MAX_CONCURRENT = register(
+    "spark_tpu.service.maxConcurrent", 2,
+    doc="Admission control: maximum queries executing simultaneously in "
+        "the SQL service (spark_tpu/service/). Further submissions queue "
+        "up to service.queueDepth, then reject with a structured "
+        "ADMISSION_REJECTED error. The "
+        "hive-thriftserver async-pool-size seat.",
+    validator=lambda v: v >= 1)
+
+SERVICE_QUEUE_DEPTH = register(
+    "spark_tpu.service.queueDepth", 16,
+    doc="Admission control: maximum queries waiting for an execution "
+        "slot. A submission arriving with the queue full is rejected "
+        "immediately (HTTP 429 / AdmissionRejected) instead of growing "
+        "an unbounded backlog.",
+    validator=lambda v: v >= 0)
+
+SERVICE_QUEUE_TIMEOUT_MS = register(
+    "spark_tpu.service.queueTimeoutMs", 30000,
+    doc="Admission control: maximum milliseconds a queued query waits "
+        "for an execution slot before failing with a structured "
+        "ADMISSION_TIMEOUT error. 0 waits forever.",
+    validator=lambda v: v >= 0)
+
+SERVICE_HOST = register(
+    "spark_tpu.service.host", "127.0.0.1",
+    doc="Bind address for the SQL service HTTP endpoint "
+        "(spark_tpu/service/server.py).")
+
+SERVICE_PORT = register(
+    "spark_tpu.service.port", 0,
+    doc="Bind port for the SQL service HTTP endpoint. 0 picks an "
+        "ephemeral port (exposed as SqlService.port after start).")
+
+SERVICE_HBM_BUDGET = register(
+    "spark_tpu.service.hbmBudget", 0,
+    doc="Shared device (HBM) byte budget the cross-query resource "
+        "arbiter (service/arbiter.py) hands out as per-scan residency "
+        "leases across ALL concurrent queries — the "
+        "UnifiedMemoryManager.scala:49 analog of one pool shared by "
+        "every task, replacing the per-query "
+        "spark_tpu.sql.memory.deviceBudget read. A query whose scan "
+        "cannot lease its estimated footprint takes the out-of-core "
+        "spill/streaming paths instead of crashing; lease pressure "
+        "first evicts the device table cache (storage pool). 0 "
+        "disables the arbiter (legacy per-query budget semantics). "
+        "An explicitly-set per-query deviceBudget (the OOM ladder's "
+        "rung-2 overlay) still takes precedence.")
+
+SERVICE_RESULT_CACHE_BYTES = register(
+    "spark_tpu.service.resultCacheBytes", 256 << 20,
+    doc="Byte bound for the plan-fingerprint result cache (the "
+        "CacheManager/InMemoryRelation seat): materialized Arrow tables "
+        "for cache()-marked plans, LRU-evicted past the bound. The "
+        "service promotes this to ONE arbiter-owned cache shared by "
+        "every pooled session. Standalone sessions keep an unbounded "
+        "private cache (the pre-service behavior) unless this key is "
+        "explicitly set. 0 disables bounding.")
+
+SERVICE_MAX_SESSIONS = register(
+    "spark_tpu.service.maxSessions", 16,
+    doc="Maximum pooled sessions the SQL service keeps (one per "
+        "distinct `session` name in POST /sql). A request naming a new "
+        "session past the bound is rejected with a structured error.",
+    validator=lambda v: v >= 1)
+
+SERVICE_QUERY_LOG_SIZE = register(
+    "spark_tpu.service.queryLogSize", 512,
+    doc="Bound on the service's in-memory query status registry "
+        "(GET /queries/<id>): oldest finished records are dropped past "
+        "it.",
+    validator=lambda v: v >= 1)
+
 MESH_SIZE = register(
     "spark_tpu.sql.mesh.size", 0,
     doc="Number of devices on the data axis of the SPMD mesh. 0 or 1 "

@@ -86,11 +86,12 @@ class QueryExecution:
             else self.session.conf
 
     def _activate_conf(self) -> None:
-        """Apply session conf to analysis-time globals (the reference's
-        SQLConf thread-activation; the driver is single-threaded)."""
-        from .. import expr as expr_mod
-        expr_mod.CASE_SENSITIVE = bool(
-            self.session.conf.get("spark_tpu.sql.caseSensitive"))
+        """Apply session conf to analysis-time context (the reference's
+        SQLConf thread-activation — ContextVar-backed so concurrent
+        service queries on other threads keep their own value)."""
+        from ..expr import set_case_sensitive
+        set_case_sensitive(bool(
+            self.session.conf.get("spark_tpu.sql.caseSensitive")))
 
     @property
     def analyzed(self) -> L.LogicalPlan:
@@ -118,6 +119,10 @@ class QueryExecution:
         def f(node):
             fp = session._plan_fingerprint(node)
             table = session._data_cache.get(fp)
+            if table is not None:
+                # shared (service) or per-session result-cache hit: the
+                # subtree replays from the materialized Arrow table
+                session.metrics.counter("result_cache_hits").inc()
             if table is None and fp in session._cache_requests \
                     and fp != root_fp:
                 # first use inside a larger query: materialize now (the
@@ -400,12 +405,14 @@ class QueryExecution:
         return node
 
     def _stage_key(self, root: P.PhysicalPlan, mesh=None) -> str:
+        from .streaming_agg import conf_compile_suffix
         conf = self._conf
         n = int(mesh.devices.size) if mesh is not None else 1
         metrics_on = bool(conf.get("spark_tpu.sql.metrics.enabled"))
         return (root.describe()
                 + (f"#mesh{n}" if mesh is not None else "")
-                + f"#m{int(metrics_on)}")
+                + f"#m{int(metrics_on)}"
+                + conf_compile_suffix(conf))
 
     def _events_enabled(self) -> bool:
         """Whether lifecycle events are worth constructing at all: an
@@ -774,11 +781,18 @@ class QueryExecution:
         ladder, mesh failures re-plan single-device — all recorded in
         `fault_summary` and the event log."""
         from ..observability.listener import QueryStartEvent
+        from ..service import arbiter as res_arbiter
         from ..testing import faults
         from .failures import RetryPolicy
         from .recovery import RecoveryContext
         self._activate_conf()
         faults.arm(self.session.conf)
+        # cross-query arbiter lease scope (service/arbiter.py): scans
+        # this execution keeps resident lease from the shared HBM pool;
+        # everything leased is released when the execution ends. None
+        # (free) when no arbiter is installed.
+        arb_token = res_arbiter.enter_query(
+            f"{self.session.app_id}:q{self.query_id}")
         conf = self._conf
         self.fault_summary = {}
         self.fault_events = []
@@ -819,6 +833,7 @@ class QueryExecution:
             self._post_query_end(None, status="error", error=e)
             raise
         finally:
+            res_arbiter.exit_query(arb_token)
             self.session._exec_depth -= 1
             if self._recovery is not None:
                 # the memo spans recovery loops, not executions: drop
@@ -958,13 +973,24 @@ class QueryExecution:
 
         if cls is FailureClass.OOM:
             self._oom_rung += 1
+            # release this query's arbiter leases before any degraded
+            # retry: a genuine RESOURCE_EXHAUSTED means the estimate
+            # that backed them was wrong, and the retry's admit
+            # decisions must start from a clean slate (the shared pool
+            # must not stay pinned by a query that just OOMed)
+            from ..service.arbiter import release_current
+            release_current()
             if self._oom_rung == 1:
                 # rung 1: evict the device-resident table cache (the
                 # storage pool) and retry — the UnifiedMemoryManager
                 # storage-eviction move
                 from ..io.device_cache import CACHE
-                freed = CACHE.nbytes
-                CACHE.clear()
+                # release_current() above dropped THIS query's pins;
+                # any still-pinned entries are other running queries'
+                # working sets — evicting those frees no HBM (their
+                # references stay live) while zeroing the storage
+                # accounting they're counted under
+                freed = CACHE.evict_bytes(CACHE.nbytes)
                 if self._last_stage_key is not None:
                     self.session._stage_cache.pop(self._last_stage_key, None)
                 import gc
@@ -1440,19 +1466,31 @@ class QueryExecution:
         self._post_query_end(root, status="ok")
 
     def collect(self) -> pa.Table:
-        ext = self._try_external_collect()
-        if ext is not None:
-            return ext
-        batch, _, _ = self.execute_batch()
-        return batch.to_arrow()
+        # ONE arbiter lease scope spans the external-collect gate AND
+        # the execute_batch that runs when the gate says "fits
+        # resident": the residency lease granted during the gate check
+        # must stay held while the resident execution actually uses the
+        # bytes (the inner enter_query calls nest onto this owner).
+        from ..service import arbiter as res_arbiter
+        arb_token = res_arbiter.enter_query(
+            f"{self.session.app_id}:q{self.query_id}")
+        try:
+            ext = self._try_external_collect()
+            if ext is not None:
+                return ext
+            batch, _, _ = self.execute_batch()
+            return batch.to_arrow()
+        finally:
+            res_arbiter.exit_query(arb_token)
 
     def _try_external_collect(self) -> Optional[pa.Table]:
         """Out-of-core host egress (execution/external.py): ORDER BY /
-        LIMIT / plain materialization over scans past the deviceBudget
-        stream chunk-wise and spill to host Arrow — never resident."""
-        budget = int(self.session.conf.get(
-            "spark_tpu.sql.memory.deviceBudget"))
-        if budget <= 0:
+        LIMIT / plain materialization over scans past the device budget
+        — per-query deviceBudget, or the shared arbiter pool when the
+        service installed one — stream chunk-wise and spill to host
+        Arrow, never resident."""
+        from ..service import arbiter as res_arbiter
+        if not res_arbiter.out_of_core_active(self.session.conf):
             return None
         import warnings
         from ..testing import faults
@@ -1487,6 +1525,8 @@ class QueryExecution:
         policy = RetryPolicy(
             max_retries=self._max_retries(conf),
             backoff_ms=float(conf.get("spark_tpu.execution.backoffMs")))
+        arb_token = res_arbiter.enter_query(
+            f"{self.session.app_id}:q{self.query_id}:ext")
         try:
             while True:
                 try:
@@ -1511,6 +1551,7 @@ class QueryExecution:
                     self._recovery.begin_recovery_attempt()
         finally:
             self._recovery.release()
+            res_arbiter.exit_query(arb_token)
         if out is not None:
             self.phase_times["external"] = time.perf_counter() - t0
         return out

@@ -17,8 +17,11 @@ plays the spill tier:
   must be output columns) honoring ASC/DESC + NULLS FIRST/LAST;
 - plain chain      -> spill every replayed chunk and concatenate.
 
-Engages only past ``spark_tpu.sql.memory.deviceBudget`` (config.py), so
-in-budget queries keep whole-input residency and device sorts.
+Engages only when the scan cannot stay device-resident: its estimate
+exceeds the per-query ``spark_tpu.sql.memory.deviceBudget``, or the
+cross-query arbiter (service/arbiter.py) denied the residency lease
+from the shared ``spark_tpu.service.hbmBudget`` pool — in-budget
+queries keep whole-input residency and device sorts.
 """
 
 from __future__ import annotations
@@ -93,8 +96,8 @@ def _host_sort_keys(sort: P.SortExec, schema) -> Optional[Tuple]:
 def try_external_collect(session, plan: P.PhysicalPlan, conf,
                          cache: Optional[dict] = None,
                          recovery=None) -> Optional[pa.Table]:
-    budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
-    if budget <= 0:
+    from ..service.arbiter import admit_scan_resident, out_of_core_active
+    if not out_of_core_active(conf):
         return None
     from ..parallel.mesh import get_mesh
     if get_mesh(conf) is not None:
@@ -105,10 +108,9 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
     limit, sort, chain, leaf = m
     if not hasattr(leaf.source, "load_chunks"):
         return None
-    from ..io.device_cache import estimated_scan_bytes
-    est_b = estimated_scan_bytes(leaf)
-    if est_b is not None and est_b <= budget:
-        return None
+    if admit_scan_resident(conf, leaf):
+        return None  # fits resident (per-query budget or leased from
+        # the shared arbiter pool): the normal path keeps it on device
 
     # pure ORDER BY (no limit) merges on host: keys must be columns
     host_keys = None
@@ -130,7 +132,9 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
     topn = sort is not None and limit is not None
 
     def make_update():
-        key = (f"ext_collect:{plan.describe()}:{chunk_rows}")
+        from .streaming_agg import conf_compile_suffix
+        key = (f"ext_collect:{plan.describe()}:{chunk_rows}"
+               + conf_compile_suffix(conf))
         fn = cache.get(key) if cache is not None else None
         if fn is None:
             def update(b, bb):

@@ -33,6 +33,18 @@ from .recovery import CHECKPOINT_EVERY_KEY, ChunkRetrier
 CHUNK_ROWS_KEY = "spark_tpu.sql.execution.streamingChunkRows"
 
 
+def conf_compile_suffix(conf) -> str:
+    """Conf values baked into traced programs but absent from plan
+    describe() strings. Every compiled-stage cache key (executor stages
+    and the chunk drivers below) appends this, so one stage cache
+    shared across sessions with different overlays — or one session
+    mutating conf between runs — can never serve a program compiled
+    under other settings."""
+    return (f"#k{conf.get('spark_tpu.sql.aggregate.kernelMode')}"
+            f"#d{conf.get('spark_tpu.sql.aggregate.maxDirectDomain')}"
+            f"#g{conf.get('spark_tpu.sql.execution.bucketGrowth')}")
+
+
 #: join types where per-probe-chunk execution is sound: each probe row's
 #: output is independent of other probe rows (right/full append
 #: build-side rows once globally, so chunking the probe would emit them
@@ -222,7 +234,8 @@ def stream_range_aggregate(agg: "P.HashAggregateExec", chain: List,
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
     rows_total = leaf.num_rows()
 
-    key = f"stream_range:{agg.describe()}:{chunk_rows}:{rows_total}"
+    key = (f"stream_range:{agg.describe()}:{chunk_rows}:{rows_total}"
+           + conf_compile_suffix(conf))
     run = cache.get(key) if cache is not None else None
     if run is None:
         ctx = P.ExecContext(conf)
@@ -282,7 +295,8 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
         chain, conf, first.capacity, recovery)
 
     def make_update():
-        key = f"stream_scan:{agg.describe()}:{chunk_rows}"
+        key = (f"stream_scan:{agg.describe()}:{chunk_rows}"
+               + conf_compile_suffix(conf))
         bundle = cache.get(key) if cache is not None else None
         if bundle is None:
             ctx = P.ExecContext(conf)
@@ -428,7 +442,8 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
         chain, conf, first.capacity, recovery)
 
     def make_update():
-        key = f"stream_spill:{agg.describe()}:{chunk_rows}"
+        key = (f"stream_spill:{agg.describe()}:{chunk_rows}"
+               + conf_compile_suffix(conf))
         fn = cache.get(key) if cache is not None else None
         if fn is None:
             def update(b, bb):
@@ -480,12 +495,15 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
 def try_stream_aggregate_spill(agg: "P.HashAggregateExec", conf,
                                cache: Optional[dict] = None,
                                recovery=None):
-    """deviceBudget gate for the out-of-core partial-spill path: engages
-    only when the probe scan's estimated footprint exceeds
-    `spark_tpu.sql.memory.deviceBudget` (the planner-consulted memory
-    conf — UnifiedMemoryManager.scala:49's execution-pool analog)."""
-    budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
-    if budget <= 0 or agg.mode != "complete":
+    """Device-budget gate for the out-of-core partial-spill path:
+    engages when the probe scan's working set cannot stay resident —
+    its estimated footprint exceeds the per-query
+    `spark_tpu.sql.memory.deviceBudget`, or the cross-query arbiter
+    (service/arbiter.py) denied the residency lease from the shared
+    HBM pool (UnifiedMemoryManager.scala:49's execution-pool analog,
+    now genuinely shared across concurrent queries)."""
+    from ..service.arbiter import admit_scan_resident, out_of_core_active
+    if not out_of_core_active(conf) or agg.mode != "complete":
         return None
     if any(a.func.uses_row_base for a in agg.agg_exprs):
         return None  # packed-position aggs need whole-input row order
@@ -498,9 +516,7 @@ def try_stream_aggregate_spill(agg: "P.HashAggregateExec", conf,
     if not isinstance(leaf, P.ScanExec) or \
             not hasattr(leaf.source, "load_chunks"):
         return None
-    from ..io.device_cache import estimated_scan_bytes
-    est_b = estimated_scan_bytes(leaf)
-    if est_b is not None and est_b <= budget:
+    if admit_scan_resident(conf, leaf):
         return None
     return stream_scan_aggregate_spill(agg, chain, leaf, conf, cache,
                                        recovery)
@@ -670,7 +686,8 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     first = next(iter(chunks), None)
     if first is None:
         return None
-    key = f"stream_mesh:{agg.describe()}:{chunk_rows}:{n}"
+    key = (f"stream_mesh:{agg.describe()}:{chunk_rows}:{n}"
+           + conf_compile_suffix(conf))
     bundle = cache.get(key) if cache is not None else None
     if bundle is None:
         ctx = P.ExecContext(conf)
@@ -770,20 +787,23 @@ def _prefer_resident(leaf: "P.ScanExec", conf) -> bool:
     host ingest entirely — the round-3 headline perf fix)."""
     from ..io.device_cache import (CACHE_BYTES_KEY, estimated_scan_bytes,
                                    is_cached, scan_cache_key)
-    mem_budget = int(conf.get("spark_tpu.sql.memory.deviceBudget"))
-    if mem_budget > 0:
-        est = estimated_scan_bytes(leaf)
-        if est is None or est > mem_budget:
-            return False  # over the device budget: must stream
+    from ..service.arbiter import admit_scan_resident
+    # cheap disqualifiers FIRST: admit_scan_resident takes a
+    # full-estimate lease from the shared pool, and leases are held to
+    # query end — a scan that was never going to ride the cache must
+    # not reserve est-sized headroom while it streams chunk-sized
     budget = int(conf.get(CACHE_BYTES_KEY))
     if budget <= 0:
         return False
     if scan_cache_key(leaf) is None:
         return False  # uncacheable source: residency would re-ingest
-    if is_cached(leaf):
-        return True
-    est_b = estimated_scan_bytes(leaf)
-    return est_b is not None and est_b <= budget // 2
+    if not is_cached(leaf):
+        est_b = estimated_scan_bytes(leaf)
+        if est_b is None or est_b > budget // 2:
+            return False
+    return admit_scan_resident(conf, leaf)
+    # False = over the per-query budget, or the shared-pool lease was
+    # denied (arbiter): must stream
 
 
 def try_stream_aggregate(agg: "P.HashAggregateExec", conf,

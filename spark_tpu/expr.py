@@ -231,14 +231,28 @@ class ColumnRef(Expression):
 
 
 # session-level resolution mode, set from spark_tpu.sql.caseSensitive by
-# the executor before analysis/tracing (the driver is single-threaded,
-# matching the reference's thread-inheritable SQLConf activation)
-CASE_SENSITIVE = False
+# the executor before analysis/tracing. A ContextVar rather than a bare
+# module global: the SQL service runs concurrent queries from sessions
+# with different caseSensitive overlays on separate threads, and each
+# thread's activation must not stomp the others (the reference's
+# thread-inheritable SQLConf activation, contextvars edition).
+from contextvars import ContextVar
+
+_CASE_SENSITIVE: ContextVar[bool] = ContextVar(
+    "spark_tpu_case_sensitive", default=False)
+
+
+def case_sensitive() -> bool:
+    return _CASE_SENSITIVE.get()
+
+
+def set_case_sensitive(value: bool) -> None:
+    _CASE_SENSITIVE.set(bool(value))
 
 
 def _resolve_field(schema: T.Schema, name: str) -> T.Field:
     matches = [f for f in schema.fields if f.name == name]
-    if not matches and not CASE_SENSITIVE:
+    if not matches and not case_sensitive():
         matches = [f for f in schema.fields if f.name.lower() == name.lower()]
     if not matches:
         raise AnalysisError(
@@ -251,7 +265,7 @@ def _resolve_field(schema: T.Schema, name: str) -> T.Field:
 def _resolve_column(batch: Batch, name: str) -> Column:
     if name in batch.columns:
         return batch.columns[name]
-    if not CASE_SENSITIVE:
+    if not case_sensitive():
         for n, c in batch.columns.items():
             if n.lower() == name.lower():
                 return c

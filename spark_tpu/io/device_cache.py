@@ -19,6 +19,7 @@ with (size, mtime) pairs, so rewritten files miss the cache.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -38,11 +39,22 @@ def batch_nbytes(batch) -> int:
 
 
 class DeviceTableCache:
-    """LRU cache of loaded device Batches with a byte budget."""
+    """LRU cache of loaded device Batches with a byte budget.
+
+    Lock-guarded: the SQL service runs concurrent queries whose scans
+    hit/fill/evict this cache from worker threads, and the resource
+    arbiter (service/arbiter.py) evicts it under lease pressure."""
 
     def __init__(self):
+        self._lock = threading.RLock()
         self._entries: "OrderedDict[Tuple, Tuple[object, int]]" = \
             OrderedDict()
+        #: pin counts per key: entries a RUNNING query was admitted
+        #: against (the arbiter pins them) — lease-pressure eviction
+        #: must skip these, because evicting a batch another query
+        #: still references frees no HBM (the reference stays live)
+        #: while the accounting would credit its bytes as free
+        self._pins: Dict[Tuple, int] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -51,38 +63,93 @@ class DeviceTableCache:
         self.evictions = 0
 
     def get(self, key) -> Optional[object]:
-        entry = self._entries.get(key)
-        if entry is None:
-            self.misses += 1
-            return None
-        self._entries.move_to_end(key)
-        self.hits += 1
-        return entry[0]
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def contains(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
 
     def put(self, key, batch, budget: int) -> None:
         nbytes = batch_nbytes(batch)
         if nbytes > budget:
             return  # larger than the whole budget: don't thrash
-        old = self._entries.pop(key, None)
-        if old is not None:
-            self._bytes -= old[1]
-        self._entries[key] = (batch, nbytes)
-        self._bytes += nbytes
-        while self._bytes > budget and len(self._entries) > 1:
-            _, (_, evicted) = self._entries.popitem(last=False)
-            self._bytes -= evicted
-            self.evictions += 1
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (batch, nbytes)
+            self._bytes += nbytes
+            while self._bytes > budget:
+                # LRU, but skip the just-inserted key and pinned
+                # entries — running queries still reference those, so
+                # evicting them frees no HBM (evict_bytes discipline)
+                victim = next((k for k in self._entries
+                               if k != key and not self._pins.get(k)),
+                              None)
+                if victim is None:
+                    break
+                _, evicted = self._entries.pop(victim)
+                self._bytes -= evicted
+                self.evictions += 1
+
+    def evict_bytes(self, nbytes: int) -> int:
+        """Evict LRU entries until at least `nbytes` are freed (or
+        only pinned entries remain); returns bytes actually freed. The
+        storage-eviction lever the cross-query arbiter pulls when an
+        execution lease can't fit next to cached tables. Pinned
+        entries (in use by a running query) are skipped: their bytes
+        would not actually be freed."""
+        freed = 0
+        with self._lock:
+            for key in list(self._entries):
+                if freed >= nbytes:
+                    break
+                if self._pins.get(key):
+                    continue
+                _, entry_bytes = self._entries.pop(key)
+                self._bytes -= entry_bytes
+                self.evictions += 1
+                freed += entry_bytes
+        return freed
+
+    def pin(self, key) -> bool:
+        """Mark `key` in-use by a running query (counted); False when
+        the entry is not present (caller falls back to leasing)."""
+        with self._lock:
+            if key not in self._entries:
+                return False
+            self._pins[key] = self._pins.get(key, 0) + 1
+            return True
+
+    def unpin(self, key) -> None:
+        with self._lock:
+            n = self._pins.get(key)
+            if n is not None:
+                if n <= 1:
+                    del self._pins[key]
+                else:
+                    self._pins[key] = n - 1
 
     def invalidate_token(self, token) -> None:
         """Drop every entry whose source stamp is `token`."""
-        for k in [k for k in self._entries if k[0] == token]:
-            _, nbytes = self._entries.pop(k)
-            self._bytes -= nbytes
+        with self._lock:
+            for k in [k for k in self._entries if k[0] == token]:
+                _, nbytes = self._entries.pop(k)
+                self._bytes -= nbytes
 
     def clear(self) -> None:
-        self.evictions += len(self._entries)
-        self._entries.clear()
-        self._bytes = 0
+        with self._lock:
+            self.evictions += len(self._entries)
+            self._entries.clear()
+            self._pins.clear()  # unpin on ghost keys is a no-op
+            self._bytes = 0
 
     @property
     def nbytes(self) -> int:
@@ -91,9 +158,10 @@ class DeviceTableCache:
     def stats(self) -> Dict[str, int]:
         """Observability snapshot (the metrics listener publishes these
         as device_cache_* gauges at every query end)."""
-        return {"hits": self.hits, "misses": self.misses,
-                "evictions": self.evictions, "bytes": self._bytes,
-                "entries": len(self._entries)}
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions, "bytes": self._bytes,
+                    "entries": len(self._entries)}
 
 
 #: process-level cache (the session is effectively a singleton; HBM is a
@@ -139,7 +207,7 @@ def estimated_scan_bytes(scan) -> Optional[int]:
 
 def is_cached(scan) -> bool:
     key = scan_cache_key(scan)
-    return key is not None and key in CACHE._entries
+    return key is not None and CACHE.contains(key)
 
 
 def load_scan(scan, conf) -> object:
@@ -153,4 +221,9 @@ def load_scan(scan, conf) -> object:
     batch = scan.load()
     if key is not None:
         CACHE.put(key, batch, budget)
+        # the bytes now count as STORAGE (headroom subtracts
+        # CACHE.nbytes): a residency lease the running query took for
+        # this scan would double-count — convert it to a pin
+        from ..service.arbiter import note_scan_cached
+        note_scan_cached(key)
     return batch

@@ -28,7 +28,8 @@ the engine-sized analog, organized the same way:
 
 from .listener import (AnalysisEvent, FaultEvent, ListenerBus,
                        QueryEndEvent, QueryListener, QueryStartEvent,
-                       StageCompiledEvent, StageCompletedEvent)
+                       ServiceEvent, StageCompiledEvent,
+                       StageCompletedEvent)
 from .metrics import (METRIC_PREFIXES, MetricsRegistry,
                       is_registered_metric)
 from .spans import Span, SpanRecorder, to_chrome_trace
@@ -36,7 +37,7 @@ from .spans import Span, SpanRecorder, to_chrome_trace
 __all__ = [
     "AnalysisEvent", "FaultEvent", "ListenerBus", "MetricsRegistry",
     "METRIC_PREFIXES",
-    "QueryEndEvent", "QueryListener", "QueryStartEvent", "Span",
-    "SpanRecorder", "StageCompiledEvent", "StageCompletedEvent",
+    "QueryEndEvent", "QueryListener", "QueryStartEvent", "ServiceEvent",
+    "Span", "SpanRecorder", "StageCompiledEvent", "StageCompletedEvent",
     "is_registered_metric", "to_chrome_trace",
 ]

@@ -79,6 +79,21 @@ class FaultEvent:
 
 
 @dataclass
+class ServiceEvent:
+    """Posted by the SQL service (spark_tpu/service/) for every
+    admission/lifecycle transition of a submitted query: `action` is
+    one of submitted / admitted / queued / rejected / queue_timeout /
+    finished / failed / evicted. `query_id` is the SERVICE query id
+    (the `GET /queries/<id>` handle), not a session-internal one."""
+
+    query_id: str
+    ts: float
+    action: str
+    session: str = ""
+    detail: str = ""
+
+
+@dataclass
 class QueryEndEvent:
     """Posted when an execution finishes (status 'ok') or fails past
     recovery (status 'error'). `event` is the full event-log record —
@@ -93,7 +108,8 @@ class QueryEndEvent:
 
 #: callback names the bus will deliver (anything else is a bug)
 CALLBACKS = ("on_query_start", "on_analysis", "on_stage_compiled",
-             "on_stage_completed", "on_fault", "on_query_end")
+             "on_stage_completed", "on_fault", "on_query_end",
+             "on_service")
 
 
 class QueryListener:
@@ -123,6 +139,9 @@ class QueryListener:
     def on_query_end(self, event: QueryEndEvent) -> None:
         pass
 
+    def on_service(self, event: ServiceEvent) -> None:
+        pass
+
 
 class ListenerBus:
     """Synchronous delivery to registered listeners, failure-isolated."""
@@ -146,7 +165,9 @@ class ListenerBus:
 
     def post(self, callback: str, event) -> None:
         assert callback in CALLBACKS, callback
-        for listener in self._listeners:
+        # snapshot: service threads may (un)register listeners while
+        # another thread's query is mid-post
+        for listener in list(self._listeners):
             fn = getattr(listener, callback, None)
             if fn is None:
                 continue

@@ -59,13 +59,20 @@ def is_registered_metric(name: str) -> bool:
 # ---------------------------------------------------------------------------
 
 class Counter:
-    __slots__ = ("value",)
+    """Monotonic counter. `inc` is lock-guarded: under the concurrent
+    SQL service, multiple query threads increment the same (shared-
+    registry) counters, and `value += n` is a read-modify-write that
+    loses updates un-locked."""
+
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n=1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
@@ -75,23 +82,25 @@ class Gauge:
         self.value = 0
 
     def set(self, v) -> None:
-        self.value = v
+        self.value = v  # single attribute store: atomic under the GIL
 
 
 class Timer:
-    __slots__ = ("count", "total_s", "min_s", "max_s")
+    __slots__ = ("count", "total_s", "min_s", "max_s", "_lock")
 
     def __init__(self):
         self.count = 0
         self.total_s = 0.0
         self.min_s = float("inf")
         self.max_s = 0.0
+        self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
-        self.count += 1
-        self.total_s += seconds
-        self.min_s = min(self.min_s, seconds)
-        self.max_s = max(self.max_s, seconds)
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.min_s = min(self.min_s, seconds)
+            self.max_s = max(self.max_s, seconds)
 
 
 class MetricsRegistry:
@@ -99,6 +108,9 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        #: serializes sink writes (concurrent query-end flushes from
+        #: service worker threads must not interleave JSONL lines)
+        self._flush_lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timer] = {}
@@ -147,14 +159,16 @@ class MetricsRegistry:
         out_dir = str(conf.get(self.DIR_KEY))
         snap = self.snapshot()
         try:
-            os.makedirs(out_dir, exist_ok=True)
-            if "jsonl" in sinks:
-                line = json.dumps(dict(snap, ts=time.time()))
-                with open(os.path.join(out_dir, "metrics.jsonl"), "a") as f:
-                    f.write(line + "\n")
-            if "prometheus" in sinks:
-                write_prometheus(os.path.join(out_dir, "metrics.prom"),
-                                 snap)
+            with self._flush_lock:
+                os.makedirs(out_dir, exist_ok=True)
+                if "jsonl" in sinks:
+                    line = json.dumps(dict(snap, ts=time.time()))
+                    with open(os.path.join(out_dir,
+                                           "metrics.jsonl"), "a") as f:
+                        f.write(line + "\n")
+                if "prometheus" in sinks:
+                    write_prometheus(os.path.join(out_dir, "metrics.prom"),
+                                     snap)
         except OSError as e:
             import warnings
             warnings.warn(f"metrics sink write failed: {e}")
@@ -171,8 +185,10 @@ def _prom_name(name: str) -> str:
     return "spark_tpu_" + _PROM_BAD.sub("_", name)
 
 
-def write_prometheus(path: str, snapshot: Dict) -> None:
-    """Atomic rewrite in Prometheus text exposition format 0.0.4."""
+def prometheus_text(snapshot: Dict) -> str:
+    """Render a registry snapshot as Prometheus text exposition format
+    0.0.4 (shared by the textfile sink below and the SQL service's
+    live `GET /metrics` endpoint)."""
     lines = []
     for name, v in sorted(snapshot.get("counters", {}).items()):
         p = _prom_name(name)
@@ -185,26 +201,36 @@ def write_prometheus(path: str, snapshot: Dict) -> None:
         lines += [f"# TYPE {p}_count counter", f"{p}_count {t['count']}",
                   f"# TYPE {p}_seconds_total counter",
                   f"{p}_seconds_total {t['total_s']}"]
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str, snapshot: Dict) -> None:
+    """Atomic rewrite in Prometheus text exposition format 0.0.4."""
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write(prometheus_text(snapshot))
     os.replace(tmp, path)
 
 
-def parse_prometheus(path: str) -> Dict[str, float]:
-    """Scrape-parse a text-exposition file back to {name: value} (used
-    by tests and the preflight smoke to prove the file is readable)."""
+def parse_prometheus_text(text: str) -> Dict[str, float]:
+    """Scrape-parse text exposition back to {name: value} (tests and
+    the preflight smokes prove the output is consumable this way)."""
     out: Dict[str, float] = {}
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            parts = line.split()
-            if len(parts) != 2:
-                raise ValueError(f"unparseable exposition line: {line!r}")
-            name, value = parts
-            if _PROM_BAD.search(name):
-                raise ValueError(f"invalid metric name: {name!r}")
-            out[name] = float(value)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, value = parts
+        if _PROM_BAD.search(name):
+            raise ValueError(f"invalid metric name: {name!r}")
+        out[name] = float(value)
     return out
+
+
+def parse_prometheus(path: str) -> Dict[str, float]:
+    """`parse_prometheus_text` over a textfile-sink file."""
+    with open(path) as f:
+        return parse_prometheus_text(f.read())

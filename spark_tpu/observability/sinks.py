@@ -62,7 +62,12 @@ class EventLogListener(QueryListener):
     MAX_BYTES_KEY = "spark_tpu.sql.eventLog.maxBytes"
 
     def __init__(self, session):
+        import threading
         self._session = session
+        #: serializes roll+append: concurrent query-end events from
+        #: service worker threads must not interleave half-written
+        #: JSON lines or double-roll the live file
+        self._write_lock = threading.Lock()
 
     def _roll(self, log_dir: str, base: str, max_bytes: int) -> None:
         try:
@@ -86,15 +91,16 @@ class EventLogListener(QueryListener):
         if not log_dir:
             return
         try:
-            os.makedirs(log_dir, exist_ok=True)
-            base = os.path.join(log_dir,
-                                f"app-{self._session.app_id}.jsonl")
-            max_bytes = int(self._session.conf.get(self.MAX_BYTES_KEY))
-            if max_bytes > 0 and os.path.exists(base):
-                self._roll(log_dir, base, max_bytes)
-            line = json.dumps(event.event, default=json_default)
-            with open(base, "a") as f:
-                f.write(line + "\n")
+            with self._write_lock:
+                os.makedirs(log_dir, exist_ok=True)
+                base = os.path.join(log_dir,
+                                    f"app-{self._session.app_id}.jsonl")
+                max_bytes = int(self._session.conf.get(self.MAX_BYTES_KEY))
+                if max_bytes > 0 and os.path.exists(base):
+                    self._roll(log_dir, base, max_bytes)
+                line = json.dumps(event.event, default=json_default)
+                with open(base, "a") as f:
+                    f.write(line + "\n")
         except (OSError, TypeError, ValueError) as e:
             # never fail a completed query over observability I/O
             warnings.warn(f"event log write failed: {e}")

@@ -118,8 +118,8 @@ class PruneColumns(Rule):
             avail = node.source.schema().names
             for f in node.pushed_filters:
                 needed = needed | f.references()
-            from ..expr import CASE_SENSITIVE
-            if CASE_SENSITIVE:
+            from ..expr import case_sensitive
+            if case_sensitive():
                 cols = tuple(n for n in avail if n in needed)
             else:
                 # match the engine's case-insensitive resolution — a

@@ -1,0 +1,163 @@
+"""Admission control for the SQL service: bounded concurrency + queue.
+
+The thriftserver seat of a bounded execution pool: at most
+`spark_tpu.service.maxConcurrent` queries execute at once; up to
+`spark_tpu.service.queueDepth` more wait; anything past that is
+rejected IMMEDIATELY with a structured error (HTTP 429 at the server),
+and a queued query that waits longer than
+`spark_tpu.service.queueTimeoutMs` fails with a structured timeout —
+load sheds at the front door instead of growing an unbounded backlog
+(the reference rejects at the pool the same way).
+
+Every transition posts a typed `ServiceEvent` on the service bus and
+counts into the shared metrics registry, so `GET /metrics` shows
+admitted/queued/rejected/timeout totals live.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class AdmissionError(RuntimeError):
+    """Base for structured admission failures: `to_dict()` is the HTTP
+    error body (and the shape tests assert on)."""
+
+    code = "ADMISSION_ERROR"
+    http_status = 500
+
+    def __init__(self, message: str, **detail):
+        super().__init__(message)
+        self.detail = detail
+
+    def to_dict(self) -> Dict:
+        return {"error": self.code, "message": str(self), **self.detail}
+
+
+class AdmissionRejected(AdmissionError):
+    """Queue full: the submission was never queued."""
+
+    code = "ADMISSION_REJECTED"
+    http_status = 429
+
+
+class AdmissionTimeout(AdmissionError):
+    """Queued, but no slot freed within queueTimeoutMs."""
+
+    code = "ADMISSION_TIMEOUT"
+    http_status = 503
+
+
+class AdmissionController:
+    """Condition-variable slot gate. `slot(...)` is a context manager:
+    entering acquires (or queues for) an execution slot, exiting
+    releases it and wakes the queue head."""
+
+    def __init__(self, max_concurrent: int, queue_depth: int,
+                 queue_timeout_ms: float, metrics=None, on_event=None):
+        self.max_concurrent = int(max_concurrent)
+        self.queue_depth = int(queue_depth)
+        self.queue_timeout_ms = float(queue_timeout_ms)
+        self.metrics = metrics
+        #: callable(action, query_id, detail) -> None; the service
+        #: routes these onto its listener bus as ServiceEvents
+        self.on_event = on_event or (lambda *a, **k: None)
+        self._cv = threading.Condition()
+        self.running = 0
+        self.queued = 0
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).inc()
+
+    def _gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("service_running").set(self.running)
+            self.metrics.gauge("service_queued").set(self.queued)
+
+    def acquire(self, query_id: str = "") -> None:
+        """Take an execution slot, queueing within bounds. Raises
+        AdmissionRejected / AdmissionTimeout (structured)."""
+        deadline = None
+        if self.queue_timeout_ms > 0:
+            deadline = time.monotonic() + self.queue_timeout_ms / 1e3
+        with self._cv:
+            # fast path only when nobody is queued: a fresh arrival
+            # must not steal a freed slot ahead of waiters (barging
+            # would starve queued requests into 503s under a steady
+            # arrival stream)
+            if self.running < self.max_concurrent and self.queued == 0:
+                self.running += 1
+                self._count("service_admitted")
+                self._gauges()
+                self.on_event("admitted", query_id)
+                return
+            if self.queued >= self.queue_depth:
+                self._count("service_rejected")
+                self.on_event("rejected", query_id,
+                              f"queueDepth={self.queue_depth}")
+                raise AdmissionRejected(
+                    f"admission queue full "
+                    f"(running={self.running}, "
+                    f"queued={self.queued}/{self.queue_depth})",
+                    running=self.running, queued=self.queued,
+                    queue_depth=self.queue_depth,
+                    max_concurrent=self.max_concurrent)
+            self.queued += 1
+            self._count("service_queued_total")
+            self._gauges()
+            self.on_event("queued", query_id)
+            try:
+                while self.running >= self.max_concurrent:
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        self._count("service_queue_timeout")
+                        self.on_event(
+                            "queue_timeout", query_id,
+                            f"queueTimeoutMs={self.queue_timeout_ms:g}")
+                        raise AdmissionTimeout(
+                            f"no execution slot within "
+                            f"{self.queue_timeout_ms:g}ms "
+                            f"(running={self.running}, "
+                            f"queued={self.queued})",
+                            running=self.running, queued=self.queued,
+                            queue_timeout_ms=self.queue_timeout_ms)
+                    self._cv.wait(remaining)
+            finally:
+                self.queued -= 1
+                self._gauges()
+            self.running += 1
+            self._count("service_admitted")
+            self._gauges()
+            self.on_event("admitted", query_id)
+
+    def release(self) -> None:
+        with self._cv:
+            self.running -= 1
+            self._gauges()
+            self._cv.notify()
+
+    class _Slot:
+        def __init__(self, ctl: "AdmissionController", query_id: str):
+            self._ctl = ctl
+            self._query_id = query_id
+
+        def __enter__(self):
+            self._ctl.acquire(self._query_id)
+            return self
+
+        def __exit__(self, *exc):
+            self._ctl.release()
+            return False
+
+    def slot(self, query_id: str = "") -> "_Slot":
+        return self._Slot(self, query_id)
+
+    def stats(self) -> Dict[str, Optional[int]]:
+        with self._cv:
+            return {"running": self.running, "queued": self.queued,
+                    "max_concurrent": self.max_concurrent,
+                    "queue_depth": self.queue_depth}

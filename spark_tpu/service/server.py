@@ -1,0 +1,562 @@
+"""The long-lived SQL service: HTTP JSON endpoint over a session pool.
+
+The `HiveThriftServer2.scala:44` analog, sized to this engine: a
+threading stdlib HTTP server (no new dependencies) in front of the
+session pool, admission controller and resource arbiter.
+
+Endpoints:
+
+- ``POST /sql``: submit a query. JSON body
+  ``{"sql": "...", "session": "name", "conf": {...}, "mode":
+  "sync"|"async", "format": "json"|"arrow"}`` (all but ``sql``
+  optional). Sync returns the result (JSON columns/rows, or an Arrow
+  IPC stream with ``format=arrow``) plus the service query id; async
+  returns 202 with the id immediately. Admission rejections are HTTP
+  429 and queue timeouts 503, both with structured JSON bodies.
+- ``GET /queries/<id>``: the query's status record, fed by the
+  listener bus (engine query id, phase times, fault events, status).
+- ``GET /metrics``: the shared metrics registry in Prometheus text
+  exposition (queries, admission, arbiter, compile/result caches).
+- ``GET /healthz``: liveness + pool/admission/arbiter stats.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..config import Conf
+from ..expr import AnalysisError
+from ..observability import ListenerBus, MetricsRegistry, QueryListener
+from ..observability.listener import ServiceEvent
+from ..observability.sinks import json_default
+from ..sql.lexer import ParseError
+from .admission import (AdmissionController, AdmissionError,
+                        AdmissionRejected, AdmissionTimeout)
+from .arbiter import (DeviceResourceArbiter, get_arbiter, install_arbiter)
+from .pool import PoolExhausted, SessionPool
+
+MAX_CONCURRENT_KEY = "spark_tpu.service.maxConcurrent"
+QUEUE_DEPTH_KEY = "spark_tpu.service.queueDepth"
+QUEUE_TIMEOUT_KEY = "spark_tpu.service.queueTimeoutMs"
+HOST_KEY = "spark_tpu.service.host"
+PORT_KEY = "spark_tpu.service.port"
+HBM_BUDGET_KEY = "spark_tpu.service.hbmBudget"
+RESULT_CACHE_KEY = "spark_tpu.service.resultCacheBytes"
+QUERY_LOG_KEY = "spark_tpu.service.queryLogSize"
+
+
+class _StatusListener(QueryListener):
+    """Pooled-session subscriber feeding `GET /queries/<id>`: engine
+    lifecycle events resolve against the service record currently
+    leased onto that session (sessions execute one query at a time)."""
+
+    def __init__(self, entry):
+        self._entry = entry
+
+    def _record(self):
+        return self._entry.current_record
+
+    def on_query_start(self, event) -> None:
+        r = self._record()
+        # first start only: a cached-subtree materialization (WITH
+        # clause) spawns a NESTED QueryExecution whose start event must
+        # not overwrite the outer query's engine id
+        if r is not None and "engine_query_id" not in r:
+            r["engine_query_id"] = event.query_id
+
+    def on_fault(self, event) -> None:
+        r = self._record()
+        if r is not None and len(r.setdefault("fault_events", [])) < 16:
+            r["fault_events"].append(
+                {"action": event.action, "error": event.error[:160]})
+
+    def on_query_end(self, event) -> None:
+        r = self._record()
+        if r is not None:
+            ev = event.event or {}
+            r["phase_times_s"] = ev.get("phase_times_s")
+            if ev.get("fault_summary"):
+                r["fault_summary"] = {
+                    k: v for k, v in ev["fault_summary"].items()
+                    if isinstance(v, (int, float))}
+
+
+class SqlService:
+    """Session pool + admission + arbiter + HTTP front end. Usable
+    embedded (`submit()`) or served (`start()`/`stop()`)."""
+
+    def __init__(self, conf: Optional[Conf] = None,
+                 init_session=None):
+        self.conf = conf or Conf()
+        self.metrics = MetricsRegistry()
+        #: service event stream (ServiceEvent per admission/lifecycle
+        #: transition) — tests and user hooks subscribe here
+        self.bus = ListenerBus()
+        self.arbiter = DeviceResourceArbiter(
+            int(self.conf.get(HBM_BUDGET_KEY)), metrics=self.metrics,
+            result_cache_bytes=int(self.conf.get(RESULT_CACHE_KEY)))
+        self._installed_arbiter = False
+        self.pool = SessionPool(
+            self.conf, self.metrics, self.arbiter,
+            init_session=init_session, make_listener=_StatusListener)
+        self.admission = AdmissionController(
+            int(self.conf.get(MAX_CONCURRENT_KEY)),
+            int(self.conf.get(QUEUE_DEPTH_KEY)),
+            float(self.conf.get(QUEUE_TIMEOUT_KEY)),
+            metrics=self.metrics, on_event=self._post)
+        self._records: "OrderedDict[str, Dict]" = OrderedDict()
+        self._records_lock = threading.Lock()
+        #: in-flight async submissions (each is a worker thread):
+        #: bounded at maxConcurrent + queueDepth so an async burst
+        #: sheds at the front door like sync traffic does, instead of
+        #: accumulating one blocked thread per request
+        self._async_inflight = 0
+        self._async_lock = threading.Lock()
+        self._record_bound = int(self.conf.get(QUERY_LOG_KEY))
+        self._seq = 0
+        self._started_ts = time.time()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # -- service event stream ----------------------------------------------
+
+    def _post(self, action: str, query_id: str, detail: str = "",
+              session: str = "") -> None:
+        rec = self.get_query(query_id)
+        if rec is not None and len(rec.setdefault("events", [])) < 32:
+            rec["events"].append({"ts": time.time(), "action": action})
+        self.bus.post("on_service", ServiceEvent(
+            query_id=query_id, ts=time.time(), action=action,
+            session=session, detail=detail))
+
+    # -- query registry -----------------------------------------------------
+
+    def _new_record(self, sql: str, session: str) -> Dict:
+        with self._records_lock:
+            self._seq += 1
+            rid = f"q-{self._seq}"
+            record = {"id": rid, "sql": sql[:500], "session": session,
+                      "status": "submitted", "submitted_ts": time.time()}
+            self._records[rid] = record
+            # bound the registry by evicting oldest FINISHED records
+            # only: a running/async record is a client's only handle to
+            # its query — dropping it would 404 the status poll and
+            # orphan later lifecycle transitions. Unfinished records
+            # are themselves bounded by admission (maxConcurrent +
+            # queueDepth), so the registry stays near the bound.
+            if len(self._records) > self._record_bound:
+                for old_id in list(self._records):
+                    if len(self._records) <= self._record_bound:
+                        break
+                    if self._records[old_id]["status"] not in (
+                            "submitted", "running"):
+                        del self._records[old_id]
+        return record
+
+    def get_query(self, query_id: str) -> Optional[Dict]:
+        with self._records_lock:
+            return self._records.get(query_id)
+
+    def query_snapshot(self, query_id: str) -> Optional[Dict]:
+        """Serialization-safe copy of a record: GET /queries/<id> must
+        not json-iterate the live dict a worker thread is mutating
+        (dict-changed-size mid-dump)."""
+        rec = self.get_query(query_id)
+        if rec is None:
+            return None
+        snap = dict(rec)  # C-level copy: atomic under the GIL
+        for k in ("events", "fault_events"):
+            if k in snap:
+                snap[k] = list(snap[k])
+        return snap
+
+    # -- submission ---------------------------------------------------------
+
+    def _ensure_arbiter(self) -> None:
+        """Install the shared arbiter (when service.hbmBudget > 0) on
+        first use — submit() must arbitrate HBM whether the service is
+        embedded or start()ed; stop() uninstalls what we installed."""
+        if (not self._installed_arbiter and self.arbiter.total > 0
+                and get_arbiter() is None):
+            install_arbiter(self.arbiter)
+            self._installed_arbiter = True
+
+    def _lock_session(self, entry, session: str, query_id: str) -> None:
+        """Lease the named session (its execution is serialized),
+        bounded by the queueTimeoutMs discipline so a request stuck
+        behind a long-running query sheds with a structured 503
+        instead of waiting forever."""
+        timeout_ms = self.admission.queue_timeout_ms
+        if entry.lock.acquire(
+                timeout=timeout_ms / 1e3 if timeout_ms > 0 else -1):
+            return
+        self.metrics.counter("service_queue_timeout").inc()
+        self._post("queue_timeout", query_id,
+                   detail=f"session={session} busy", session=session)
+        raise AdmissionTimeout(
+            f"session '{session}' still busy after {timeout_ms:g}ms",
+            session=session, queue_timeout_ms=timeout_ms)
+
+    def submit(self, sql: str, session: str = "default",
+               conf: Optional[Dict] = None):
+        """Run `sql` on the named pooled session under admission
+        control. Returns (record, Arrow table). Raises AdmissionError /
+        PoolExhausted (structured) or whatever the engine raised; the
+        record reflects the outcome either way."""
+        record = self._new_record(sql, session)
+        rid = record["id"]
+        self._ensure_arbiter()
+        self.metrics.counter("service_queries_submitted").inc()
+        self._post("submitted", rid, session=session)
+        try:
+            # session serialization FIRST, admission slot second: a
+            # request blocked behind a busy session must not hold one
+            # of the maxConcurrent execution slots while doing no work
+            # (it would starve other sessions' requests into 429/503)
+            entry = self.pool.get_or_create(session)
+            self._lock_session(entry, session, rid)
+            try:
+                # overrides land inside the same lock window the query
+                # executes in: sticky per-session SET semantics, and a
+                # concurrent request can neither clobber them before
+                # this query runs nor land its own mid-query
+                if conf:
+                    for k, v in conf.items():
+                        entry.session.conf.set(k, v)
+                with self.admission.slot(rid):
+                    entry.current_record = record
+                    record["status"] = "running"
+                    record["started_ts"] = time.time()
+                    try:
+                        with entry.session.as_active():
+                            qe = entry.session.sql(sql)._qe()
+                            table = qe.collect()
+                    finally:
+                        entry.current_record = None
+            finally:
+                entry.lock.release()
+        except AdmissionError as e:
+            record["status"] = ("rejected"
+                                if e.code == "ADMISSION_REJECTED"
+                                else "queue_timeout")
+            e.detail.setdefault("query_id", rid)
+            record["error"] = e.to_dict()
+            record["finished_ts"] = time.time()
+            raise
+        except PoolExhausted as e:
+            # capacity rejection, not an engine failure: must not count
+            # into service_failed or read as EXECUTION_ERROR in the
+            # record (the HTTP layer returns 429 for it)
+            record["status"] = "rejected"
+            record["error"] = e.to_dict()
+            record["finished_ts"] = time.time()
+            self.metrics.counter("service_rejected").inc()
+            self._post("rejected", rid, detail="maxSessions",
+                       session=session)
+            raise
+        except Exception as e:  # noqa: BLE001 — recorded, then surfaced
+            record["status"] = "error"
+            code = ("INVALID_SQL"
+                    if isinstance(e, (ParseError, AnalysisError))
+                    else "EXECUTION_ERROR")
+            record["error"] = {"error": code,
+                               "message": f"{type(e).__name__}: {e}"[:400]}
+            record["finished_ts"] = time.time()
+            self.metrics.counter("service_failed").inc()
+            self._post("failed", rid, detail=type(e).__name__,
+                       session=session)
+            raise
+        record["status"] = "ok"
+        record["row_count"] = int(table.num_rows)
+        record["finished_ts"] = time.time()
+        record["elapsed_ms"] = round(
+            (record["finished_ts"] - record["started_ts"]) * 1e3, 1)
+        self.metrics.counter("service_completed").inc()
+        self._post("finished", rid, session=session)
+        return record, table
+
+    def submit_async(self, sql: str, session: str = "default",
+                     conf: Optional[Dict] = None) -> Dict:
+        """Fire-and-poll submission: returns the record immediately;
+        progress lands on it (GET /queries/<id>). The worker thread
+        holds no result — async is for effects/status, sync for data.
+        Raises AdmissionRejected (structured, HTTP 429) when
+        maxConcurrent + queueDepth async submissions are already in
+        flight."""
+        record = self._new_record(sql, session)
+        bound = (self.admission.max_concurrent
+                 + self.admission.queue_depth)
+        with self._async_lock:
+            if self._async_inflight >= bound:
+                err = AdmissionRejected(
+                    f"async submissions in flight at bound "
+                    f"({self._async_inflight}/{bound})",
+                    in_flight=self._async_inflight, bound=bound,
+                    query_id=record["id"])
+                record["status"] = "rejected"
+                record["error"] = err.to_dict()
+                record["finished_ts"] = time.time()
+                self.metrics.counter("service_rejected").inc()
+                self._post("rejected", record["id"],
+                           detail="asyncInFlight", session=session)
+                raise err
+            self._async_inflight += 1
+
+        def run():
+            # re-drive through submit's machinery minus re-registration
+            # (same ordering as submit: session lease, then slot)
+            try:
+                entry = self.pool.get_or_create(session)
+                self._lock_session(entry, session, record["id"])
+                try:
+                    if conf:
+                        for k, v in conf.items():
+                            entry.session.conf.set(k, v)
+                    with self.admission.slot(record["id"]):
+                        entry.current_record = record
+                        record["status"] = "running"
+                        record["started_ts"] = time.time()
+                        try:
+                            with entry.session.as_active():
+                                t = entry.session.sql(sql)._qe().collect()
+                            record["row_count"] = int(t.num_rows)
+                            record["status"] = "ok"
+                            self.metrics.counter(
+                                "service_completed").inc()
+                            self._post("finished", record["id"],
+                                       session=session)
+                        finally:
+                            entry.current_record = None
+                finally:
+                    entry.lock.release()
+            except AdmissionError as e:
+                record["status"] = ("rejected"
+                                    if e.code == "ADMISSION_REJECTED"
+                                    else "queue_timeout")
+                record["error"] = e.to_dict()
+            except PoolExhausted as e:
+                record["status"] = "rejected"
+                record["error"] = e.to_dict()
+                self.metrics.counter("service_rejected").inc()
+                self._post("rejected", record["id"],
+                           detail="maxSessions", session=session)
+            except Exception as e:  # noqa: BLE001 — poll-visible
+                record["status"] = "error"
+                code = ("INVALID_SQL"
+                        if isinstance(e, (ParseError, AnalysisError))
+                        else "EXECUTION_ERROR")
+                record["error"] = {
+                    "error": code,
+                    "message": f"{type(e).__name__}: {e}"[:400]}
+                self.metrics.counter("service_failed").inc()
+                self._post("failed", record["id"], session=session)
+            finally:
+                with self._async_lock:
+                    self._async_inflight -= 1
+            record["finished_ts"] = time.time()
+
+        self._ensure_arbiter()
+        self.metrics.counter("service_queries_submitted").inc()
+        self._post("submitted", record["id"], session=session)
+        threading.Thread(target=run, daemon=True,
+                         name=f"sql-{record['id']}").start()
+        return record
+
+    # -- endpoints' data ----------------------------------------------------
+
+    def metrics_text(self) -> str:
+        from ..observability.metrics import prometheus_text
+        return prometheus_text(self.metrics.snapshot())
+
+    def health(self) -> Dict:
+        return {"status": "ok",
+                "uptime_s": round(time.time() - self._started_ts, 1),
+                "sessions": len(self.pool),
+                "admission": self.admission.stats(),
+                "arbiter": self.arbiter.stats()
+                if self._installed_arbiter else None}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "SqlService":
+        """Install the arbiter (when hbmBudget > 0) and serve HTTP on
+        service.{host,port} from a daemon thread."""
+        self._ensure_arbiter()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(
+            (str(self.conf.get(HOST_KEY)), int(self.conf.get(PORT_KEY))),
+            handler)
+        self._httpd.daemon_threads = True
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="sql-service-http")
+        self._serve_thread.start()
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return None if self._httpd is None \
+            else self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        """Clean shutdown: stop accepting, close the socket, uninstall
+        the arbiter if this service installed it."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=10)
+            self._serve_thread = None
+        if self._installed_arbiter:
+            install_arbiter(None)
+            self._installed_arbiter = False
+
+
+# ---------------------------------------------------------------------------
+# HTTP plumbing
+# ---------------------------------------------------------------------------
+
+
+def _table_rows(table) -> list:
+    """Arrow table -> JSON-friendly row dicts: decimals to float, dates
+    and timestamps to ISO strings (repr-degrading them through the
+    event-log encoder would leak Python syntax to HTTP clients)."""
+    import datetime
+    import decimal
+    rows = table.to_pylist()
+    for row in rows:
+        for k, v in row.items():
+            if isinstance(v, decimal.Decimal):
+                row[k] = float(v)
+            elif isinstance(v, (datetime.date, datetime.datetime)):
+                row[k] = v.isoformat()
+    return rows
+
+
+def _make_handler(service: SqlService):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet: metrics cover it
+            pass
+
+        def _send_json(self, status: int, payload: Dict) -> None:
+            body = json.dumps(payload, default=json_default).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, status: int, text: str,
+                       content_type: str) -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                self._send_json(200, service.health())
+            elif path == "/metrics":
+                self._send_text(
+                    200, service.metrics_text(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif path.startswith("/queries/"):
+                rec = service.query_snapshot(path[len("/queries/"):])
+                if rec is None:
+                    self._send_json(404, {"error": "NOT_FOUND",
+                                          "message": path})
+                else:
+                    self._send_json(200, rec)
+            else:
+                self._send_json(404, {"error": "NOT_FOUND",
+                                      "message": path})
+
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            if path != "/sql":
+                # drain the body first: on an HTTP/1.1 keep-alive
+                # connection unread body bytes would be parsed as the
+                # start of the NEXT request (stream desync)
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
+                self._send_json(404, {"error": "NOT_FOUND",
+                                      "message": path})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(n) or b"{}")
+                sql = req.get("sql")
+                if not sql or not isinstance(sql, str):
+                    self._send_json(400, {
+                        "error": "BAD_REQUEST",
+                        "message": "body must be JSON with a 'sql' "
+                                   "string"})
+                    return
+            except (ValueError, TypeError) as e:
+                self._send_json(400, {"error": "BAD_REQUEST",
+                                      "message": str(e)[:200]})
+                return
+            session = str(req.get("session") or "default")
+            conf = req.get("conf") or None
+            if req.get("mode") == "async":
+                try:
+                    record = service.submit_async(sql, session, conf)
+                except AdmissionError as e:
+                    self._send_json(e.http_status, e.to_dict())
+                    return
+                self._send_json(202, {"query_id": record["id"],
+                                      "status": record["status"]})
+                return
+            try:
+                record, table = service.submit(sql, session, conf)
+            except AdmissionError as e:
+                self._send_json(e.http_status, e.to_dict())
+                return
+            except PoolExhausted as e:
+                self._send_json(429, e.to_dict())
+                return
+            except (ParseError, AnalysisError) as e:
+                self._send_json(400, {
+                    "error": "INVALID_SQL",
+                    "message": f"{type(e).__name__}: {e}"[:400]})
+                return
+            except Exception as e:  # noqa: BLE001 — structured surface
+                self._send_json(500, {
+                    "error": "EXECUTION_ERROR",
+                    "message": f"{type(e).__name__}: {e}"[:400]})
+                return
+            if req.get("format") == "arrow":
+                import io
+                import pyarrow as pa
+                buf = io.BytesIO()
+                with pa.ipc.new_stream(buf, table.schema) as w:
+                    w.write_table(table)
+                body = buf.getvalue()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/vnd.apache.arrow.stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Query-Id", record["id"])
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self._send_json(200, {
+                "query_id": record["id"], "status": record["status"],
+                "columns": table.column_names,
+                "rows": _table_rows(table),
+                "row_count": record.get("row_count"),
+                "elapsed_ms": record.get("elapsed_ms")})
+
+    return Handler

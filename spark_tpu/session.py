@@ -6,6 +6,8 @@ Reference: `sql/core/src/main/scala/org/apache/spark/sql/SparkSession.scala:83`
 
 from __future__ import annotations
 
+import contextlib
+from contextvars import ContextVar
 from typing import Dict, Optional
 
 import pandas as pd
@@ -17,11 +19,37 @@ from .dataframe import DataFrame
 from .io.sources import ArrowTableSource, ParquetSource, TableSource
 from .plan import logical as L
 
+#: context-local active session (the SQL service pins one per worker
+#: thread with `session.as_active()`); falls back to the process-global
+#: singleton below, preserving the historical single-caller behavior
+_ACTIVE: ContextVar[Optional["SparkTpuSession"]] = ContextVar(
+    "spark_tpu_active_session", default=None)
 
-class SparkTpuSession:
-    _active: Optional["SparkTpuSession"] = None
 
-    def __init__(self, conf: Optional[Conf] = None):
+class _ActiveSessionMeta(type):
+    """`SparkTpuSession._active` used to be a process-global class
+    attribute; under the concurrent SQL service it resolves per context
+    (each worker thread sees the session it activated) with the global
+    as fallback. Reads and writes of the class attribute keep working
+    unchanged — tests assign `SparkTpuSession._active = None` and the
+    builder reads it — via this metaclass property."""
+
+    @property
+    def _active(cls) -> Optional["SparkTpuSession"]:
+        s = _ACTIVE.get()
+        return s if s is not None else cls._global_active
+
+    @_active.setter
+    def _active(cls, value: Optional["SparkTpuSession"]) -> None:
+        cls._global_active = value
+        _ACTIVE.set(value)
+
+
+class SparkTpuSession(metaclass=_ActiveSessionMeta):
+    _global_active: Optional["SparkTpuSession"] = None
+
+    def __init__(self, conf: Optional[Conf] = None,
+                 register_active: bool = True):
         self.conf = conf or Conf()
         from .catalog import Catalog
         self.catalog: Catalog = Catalog(self)
@@ -45,7 +73,17 @@ class SparkTpuSession:
         # requested marks fill with materialized Arrow tables on first
         # action; later plans substitute equal subtrees with cached scans
         self._cache_requests: Dict[str, object] = {}  # fp -> LogicalPlan
-        self._data_cache: Dict[str, pa.Table] = {}
+        from .service.arbiter import RESULT_CACHE_BYTES_KEY, ResultCache
+        # standalone sessions keep the pre-service unbounded cache
+        # unless the bound is explicitly configured: a cache()-marked
+        # table larger than a default bound would silently recompute
+        # per reference. Pooled sessions get this replaced by the
+        # arbiter's shared, conf-bounded cache (service/pool.py).
+        self._data_cache = ResultCache(
+            max_bytes=(int(self.conf.get(RESULT_CACHE_BYTES_KEY))
+                       if self.conf.is_explicitly_set(RESULT_CACHE_BYTES_KEY)
+                       else 0),
+            metrics=self.metrics)
         self._implicit_cache_fps: set = set()
         self._exec_depth = 0  # outermost-execution tracking for eviction
         # plan-fingerprint -> {kind:tag -> capacity} discovered by the
@@ -54,7 +92,21 @@ class SparkTpuSession:
         self._aqe_caps: Dict[str, Dict[str, int]] = {}
         from .udf import UDFRegistration
         self.udf = UDFRegistration(self)
-        SparkTpuSession._active = self
+        if register_active:
+            SparkTpuSession._active = self
+
+    @contextlib.contextmanager
+    def as_active(self):
+        """Pin this session as the context-local active session (what
+        `builder().get_or_create()` returns) for the enclosed block —
+        the SQL service wraps each query execution in this so pooled
+        sessions never stomp the process-global singleton or each
+        other."""
+        token = _ACTIVE.set(self)
+        try:
+            yield self
+        finally:
+            _ACTIVE.reset(token)
 
     # -- observability ------------------------------------------------------
 
