@@ -252,6 +252,71 @@ def bench_kernel_pick(spark):
     return out
 
 
+def bench_join_microbench(spark):
+    """Hash vs sort join-kernel microbench: inner-join probe rows/s at
+    1M and 16M probe rows against a 64k-row build side (duplicate keys
+    included so the many-to-many expansion runs). The result feeds the
+    kernel-choice heuristics (join.hashMinProbeRows /
+    hashProbeBuildRatio) with measured crossover data per platform."""
+    import numpy as np
+    import pandas as pd
+
+    from spark_tpu import functions as F
+    from spark_tpu.functions import col
+
+    mode_key = "spark_tpu.sql.join.kernelMode"
+    old_mode = spark.conf.get(mode_key)
+    build_n = 1 << 16
+    # BENCH_JOIN_PROBE_ROWS: comma list of probe sizes (preflight
+    # smokes shrink it; the default pair is the BENCH trajectory shape)
+    probe_sizes = [int(v) for v in os.environ.get(
+        "BENCH_JOIN_PROBE_ROWS", f"{1 << 20},{1 << 24}").split(",")]
+    rs = np.random.RandomState(42)
+    dim = pd.DataFrame({
+        # ~1/16 duplicated build keys: exercises expansion without
+        # blowing the out_cap past the probe capacity
+        "k2": np.concatenate([
+            np.arange(build_n - (build_n >> 4), dtype=np.int64),
+            rs.randint(0, build_n >> 4, build_n >> 4)]),
+        "w": np.arange(build_n, dtype=np.int64)})
+    spark.register_table("jmb_dim", dim)
+    out = {}
+    try:
+        for probe_n in probe_sizes:
+            label = f"{probe_n >> 20}m" if probe_n >= 1 << 20 \
+                else f"{probe_n >> 10}k"
+            fact = pd.DataFrame({
+                "k": rs.randint(0, build_n, probe_n).astype(np.int64),
+                "v": np.arange(probe_n, dtype=np.int64)})
+            spark.register_table("jmb_fact", fact)
+            for mode in ("sort", "hash"):
+                spark.conf.set(mode_key, mode)
+                # aggregate the join output so timing measures the
+                # kernel, not a multi-million-row host transfer
+                df = (spark.table("jmb_fact")
+                      .join(spark.table("jmb_dim"), left_on=col("k"),
+                            right_on=col("k2"))
+                      .agg(F.sum(col("v") + col("w")).alias("s")))
+                qe = df._qe()
+
+                def run_sync():
+                    b, _, _ = qe.execute_batch()
+                    import jax
+                    jax.device_get(b.columns["s"].data)
+                    return b
+
+                best = _time3(run_sync)
+                out[f"join_{label}_{mode}_rows_per_sec_M"] = round(
+                    probe_n / best / 1e6, 1)
+            srt = out[f"join_{label}_sort_rows_per_sec_M"]
+            hsh = out[f"join_{label}_hash_rows_per_sec_M"]
+            if srt:
+                out[f"join_{label}_hash_speedup"] = round(hsh / srt, 3)
+    finally:
+        spark.conf.set(mode_key, old_mode)
+    return out
+
+
 def bench_tpch(spark, sf: float, path: str, queries=("q1", "q6", "q3",
                                                      "q5"),
                float_atol: float = 1e-4, deadline: float = None):
@@ -301,6 +366,9 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
         # clean run — nonzero means the TPU runtime flaked mid-stream
         # (and the stream resumed instead of restarting)
         rec0 = spark.metrics.counter("rec_chunks_replayed").value
+        # ingest-pipeline sidecar baselines (registry counters)
+        stall0 = spark.metrics.counter("ingest_stall_ms").value
+        overlap0 = spark.metrics.counter("ingest_overlap_ms").value
         _, got = run_once()  # warmup (compile + first ingest)
         times = []
         qe = None
@@ -330,6 +398,24 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
                 c.get("peak_hbm_bytes") or 0 for c in costs))
         extra[f"tpch_{name}_sf{sf:g}_rec_chunks_replayed"] = int(
             spark.metrics.counter("rec_chunks_replayed").value - rec0)
+        # hash-join kernel sidecar: per-join table build/probe program
+        # cost (0.0 when every join took the sort path — expected on
+        # small probes under kernelMode=auto)
+        extra[f"tpch_{name}_sf{sf:g}_join_build_ms"] = round(sum(
+            v for k, v in qe.last_metrics.items()
+            if k.startswith("join_build_ms_")), 3)
+        slots = [v for k, v in qe.last_metrics.items()
+                 if k.startswith("join_table_slots_")]
+        if slots:
+            extra[f"tpch_{name}_sf{sf:g}_join_table_slots"] = int(
+                max(slots))
+        # ingest pipeline sidecar: decode time hidden behind compute
+        # vs consumer stalls, across this query's warmup+timed runs
+        extra[f"tpch_{name}_sf{sf:g}_ingest_overlap_ms"] = round(
+            spark.metrics.counter("ingest_overlap_ms").value
+            - overlap0, 3)
+        extra[f"tpch_{name}_sf{sf:g}_ingest_stall_ms"] = round(
+            spark.metrics.counter("ingest_stall_ms").value - stall0, 3)
         # static-analyzer sidecar: findings per query (the BENCH
         # trajectory must show analyzer noise staying at zero on the
         # TPC-H suite; a nonzero count is either a real hazard at this
@@ -423,6 +509,10 @@ def main():
     emit_summary()
     extra.update(run_budgeted(
         "kernel_pick", lambda: bench_kernel_pick(spark), budget))
+    emit_summary()
+    extra.update(run_budgeted(
+        "join_microbench", lambda: bench_join_microbench(spark),
+        budget))
     emit_summary()
     # the TPC-H trajectory is the headline consumer of BENCH rounds:
     # give it whatever remains of the total budget (at least its

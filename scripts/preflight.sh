@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Seven stages, all mandatory:
+# Eight stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -37,9 +37,13 @@
 #      ephemeral port, POST TPC-H Q1 over HTTP, assert golden parity
 #      of the JSON result, that GET /metrics parses as Prometheus
 #      text exposition, and a clean shutdown
+#   8. join-kernel + ingest parity smoke: TPC-H Q3+Q5 byte-identical
+#      across join.kernelMode hash vs sort (the hash path PROVEN to
+#      have run via join_table_slots_*) and ingest.prefetch on vs off,
+#      plus a reduced-size join_microbench section run
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-6 still run) for quick
+#   --fast skips the full pytest suite (stages 2-8 still run) for quick
 #   inner-loop checks; CI and end-of-round runs must use the default.
 
 set -euo pipefail
@@ -51,7 +55,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/7: tier-1 test suite --"
+    echo "-- stage 1/8: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -65,16 +69,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/7: SKIPPED (--fast) --"
+    echo "-- stage 1/8: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/7: dryrun_multichip(8) --"
+echo "-- stage 2/8: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/7: bench smoke --"
+echo "-- stage 3/8: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -106,7 +110,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/7: chaos smoke --"
+echo "-- stage 4/8: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -160,7 +164,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/7: observability + analysis smoke --"
+echo "-- stage 5/8: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -222,10 +226,10 @@ print(json.dumps({"preflight_observability_smoke": "ok",
                   "trace_events": len(t["traceEvents"])}))
 EOF2
 
-echo "-- stage 6/7: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/8: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/7: SQL service smoke --"
+echo "-- stage 7/8: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -278,5 +282,63 @@ finally:
 print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
+
+echo "-- stage 8/8: join-kernel + ingest parity smoke --"
+# Q3+Q5 byte-identical across join.kernelMode hash/sort and
+# ingest.prefetch on/off; the hash path must actually have run (a
+# join_table_slots_* metric) so the parity check can't go vacuous.
+env JAX_PLATFORMS=cpu BENCH_JOIN_PROBE_ROWS=262144 python - <<'EOF4'
+import json
+import tempfile
+
+import pandas as pd
+
+import bench
+from spark_tpu import SparkTpuSession
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+spark = SparkTpuSession.builder().get_or_create()
+path = tempfile.mkdtemp(prefix="preflight_hj_") + "/sf"
+write_parquet(path, 0.002)
+Q.register_tables(spark, path)
+spark.conf.set("spark_tpu.sql.execution.streamingChunkRows", 4096)
+
+MODE = "spark_tpu.sql.join.kernelMode"
+PREFETCH = "spark_tpu.sql.ingest.prefetch"
+hash_proven = 0
+for qname in ("q3", "q5"):
+    outs = {}
+    for mode, prefetch in (("sort", True), ("hash", True),
+                           ("sort", False), ("hash", False)):
+        spark.conf.set(MODE, mode)
+        spark.conf.set(PREFETCH, prefetch)
+        qe = Q.QUERIES[qname](spark)._qe()
+        outs[(mode, prefetch)] = qe.collect().to_pandas()
+        if mode == "hash":
+            hash_proven += any(k.startswith("join_table_slots_")
+                               for k in qe.last_metrics)
+    base = outs[("sort", True)]
+    # normalize a COPY: normalize_decimals casts in place, and `base`
+    # must stay byte-identical for the cross-config comparisons below
+    got_n = G.normalize_decimals(base.copy()).reset_index(drop=True)
+    want = G.GOLDEN[qname](path)
+    if qname == "q5":  # revenue ties: compare in n_name order
+        got_n = got_n.sort_values("n_name").reset_index(drop=True)
+        want = want.sort_values("n_name").reset_index(drop=True)
+    G.compare(got_n, want)
+    for key, got in outs.items():
+        try:
+            pd.testing.assert_frame_equal(base, got)
+        except AssertionError as e:
+            raise AssertionError(
+                f"{qname} diverged at (kernelMode, prefetch)={key}") from e
+assert hash_proven == 4, f"hash kernel ran {hash_proven}/4 configs"
+mb = bench.bench_join_microbench(spark)
+assert any(k.endswith("_hash_rows_per_sec_M") for k in mb), mb
+print(json.dumps({"preflight_join_kernel_smoke": "ok",
+                  "microbench": mb}))
+EOF4
 
 echo "== preflight PASSED =="

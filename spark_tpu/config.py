@@ -128,6 +128,75 @@ AGG_TABLE_SIZE = register(
     doc="Estimated distinct group count used to size hash-aggregate output "
         "when no tighter bound can be inferred (AQE may revise).")
 
+JOIN_KERNEL_MODE = register(
+    "spark_tpu.sql.join.kernelMode", "auto",
+    doc="Equi-join match kernel (execution/hash_join.py vs the sorted-"
+        "build binary search in execution/join.py): 'hash' builds a "
+        "power-of-two open-addressing table over the (sorted) build "
+        "keys and probes it with a fixed-bound vectorized loop — the "
+        "BytesToBytesMap.java seat, replacing the probe-side "
+        "searchsorted sorts that dominated the join-bound TPC-H "
+        "profile; 'sort' keeps the binary-search path; 'auto' picks "
+        "hash only for large probes over comparatively small builds "
+        "(join.hashMinProbeRows / hashProbeBuildRatio), so small joins "
+        "and CPU test runs keep the sort path. Results are "
+        "byte-identical across modes (both kernels emit matches in the "
+        "same sorted-build order).",
+    validator=lambda v: v in ("auto", "hash", "sort"))
+
+JOIN_HASH_LOAD_FACTOR = register(
+    "spark_tpu.sql.join.hashLoadFactor", 0.5,
+    doc="Target load factor for the hash-join table: slots = the "
+        "smallest power of two >= build capacity / loadFactor (clamped "
+        "by join.hashMaxTableSlots). Lower = fewer probe steps, more "
+        "HBM.",
+    validator=lambda v: 0.0 < v <= 0.9)
+
+JOIN_HASH_MAX_PROBE = register(
+    "spark_tpu.sql.join.hashMaxProbe", 64,
+    doc="Fixed bound on linear-probe steps for hash-join build inserts "
+        "and probes. A build whose longest collision cluster exceeds it "
+        "raises the join_hashsat_<tag> flag and the AQE loop re-jits "
+        "that join on the sort kernel (correctness never depends on "
+        "the bound).",
+    validator=lambda v: v >= 1)
+
+JOIN_HASH_MAX_SLOTS = register(
+    "spark_tpu.sql.join.hashMaxTableSlots", 1 << 26,
+    doc="Upper bound on hash-join table slots (HBM guard: ~16 bytes "
+        "per slot). A build capacity that would push the effective "
+        "load factor past 0.7 under this clamp falls back to the sort "
+        "kernel at trace time (surfaced by the analyzer's "
+        "JOIN_HASH_TABLE_PRESSURE finding).",
+    validator=lambda v: v >= 16)
+
+JOIN_HASH_MIN_PROBE_ROWS = register(
+    "spark_tpu.sql.join.hashMinProbeRows", 1 << 19,
+    doc="kernelMode=auto: minimum probe-side capacity for the hash "
+        "kernel. Below it the sorted-build binary search wins (the "
+        "probe-side sort it pays is tiny) and tier-1 CPU runs stay on "
+        "the extensively-exercised sort path.")
+
+JOIN_HASH_PROBE_BUILD_RATIO = register(
+    "spark_tpu.sql.join.hashProbeBuildRatio", 4.0,
+    doc="kernelMode=auto: minimum probe/build capacity ratio for the "
+        "hash kernel. The hash table amortizes its build cost over "
+        "probe rows; near-square joins keep the sort path.",
+    validator=lambda v: v >= 0)
+
+INGEST_PREFETCH = register(
+    "spark_tpu.sql.ingest.prefetch", True,
+    doc="Double-buffered chunk ingest for the streaming drivers "
+        "(streaming_agg direct/spill/mesh + external collect): a "
+        "background thread decodes and dictionary-unifies Parquet "
+        "chunk N+1 into HOST buffers while chunk N computes on device "
+        "— the shuffle-fetch/compute pipelining seat (SURVEY 2.5). "
+        "Bounded to ONE in-flight chunk; device placement stays on the "
+        "consumer thread, so HBM residency, arbiter leases and the "
+        "per-chunk retry/checkpoint semantics are unchanged. Results "
+        "are identical on/off; only ingest/compute overlap changes "
+        "(ingest_overlap_ms / ingest_stall_ms counters).")
+
 SHUFFLE_PARTITIONS = register(
     "spark_tpu.sql.shuffle.partitions", 8,
     doc="Number of logical shuffle partitions (mesh data axis size).")
@@ -305,6 +374,19 @@ RUNTIME_FILTER_CREATION_THRESHOLD = register(
         "plus the Bloom build must stay cheap relative to the probe "
         "exchange it prunes. The bloomFilter.creationSideThreshold "
         "analog.")
+
+RUNTIME_FILTER_SEMI_AWARE = register(
+    "spark_tpu.sql.runtimeFilter.semiAwareCreation", True,
+    doc="When a creation-side descent passes through an equi-join whose "
+        "OTHER side is selective and cheap to recompute, synthesize a "
+        "left-semi join in the creation chain instead of dropping the "
+        "other side's effect (Q5: customer inherits the nation-region "
+        "semi, so ~4/5 of customers never enter the filter). The "
+        "synthesized semi only ever NARROWS the creation keys toward "
+        "the true build keys — pruning stays sound, it just prunes "
+        "more. Single-chip only: under a mesh the creation scans are "
+        "sharded, and a per-shard semi could drop keys whose partner "
+        "rows live on another shard.")
 
 RUNTIME_FILTER_FPP = register(
     "spark_tpu.sql.runtimeFilter.expectedFpp", 0.03,

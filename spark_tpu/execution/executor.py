@@ -260,6 +260,13 @@ class QueryExecution:
                 cap = node.out_cap
                 notes.append(f"join rows: {jr:,}"
                              + (f"/{cap:,} cap" if cap else ""))
+            slots = m.get(f"join_table_slots_{tag}")
+            if slots is not None:
+                # present only when the hash kernel ran this join
+                notes.append(
+                    f"hash table: {slots:,} slots, build "
+                    f"{m.get(f'join_build_ms_{tag}', 0)}ms, probe "
+                    f"{m.get(f'join_probe_ms_{tag}', 0)}ms")
         elif isinstance(node, P.ExchangeExec):
             mx = m.get(f"exch_max_{tag}")
             if mx is not None:
@@ -545,7 +552,8 @@ class QueryExecution:
                     # row counts sum across shards
                     red = jax.lax.pmax if k.startswith(
                         ("join_rows_", "exch_max_", "agg_groups_",
-                         "rtf_build_ms_")) \
+                         "rtf_build_ms_", "join_build_ms_",
+                         "join_probe_ms_", "join_table_slots_")) \
                         else jax.lax.psum
                     metrics[k] = red(jnp.asarray(v), AXIS)
                 return out, flags, metrics
@@ -718,6 +726,8 @@ class QueryExecution:
                 out[f"join:{root.tag}"] = root.out_cap
             if root.unique_build is False:
                 out[f"uniq:{root.tag}"] = 0
+            if root.hash_fallback is False:
+                out[f"hashfb:{root.tag}"] = 0
         elif isinstance(root, P.ExchangeExec) and root.block_cap is not None:
             out[f"exch:{root.tag}"] = root.block_cap
         elif isinstance(root, P.HashAggregateExec) and root.est_groups:
@@ -731,6 +741,8 @@ class QueryExecution:
                 self._set_join_cap(root, tag, cap)
             elif kind == "uniq":
                 self._set_join_nonunique(root, tag)
+            elif kind == "hashfb":
+                self._set_join_hash_fallback(root, tag)
             elif kind == "exch":
                 self._set_exchange_cap(root, tag, cap)
             else:
@@ -749,6 +761,17 @@ class QueryExecution:
             QueryExecution._set_join_nonunique(c, tag)
         if isinstance(root, P.JoinExec) and root.tag == tag:
             root.unique_build = False
+
+    @staticmethod
+    def _set_join_hash_fallback(root: P.PhysicalPlan, tag: str) -> None:
+        """The hash kernel's open table saturated for this join (a
+        collision cluster outran join.hashMaxProbe): pin it to the sort
+        kernel and re-jit — a correctness re-plan like the unique-build
+        fallback, never capacity growth."""
+        for c in root.children:
+            QueryExecution._set_join_hash_fallback(c, tag)
+        if isinstance(root, P.JoinExec) and root.tag == tag:
+            root.hash_fallback = False
 
     @staticmethod
     def _set_exchange_cap(root: P.PhysicalPlan, tag: str, cap: int) -> None:
@@ -1192,6 +1215,7 @@ class QueryExecution:
                 overflow = [k for k, v in flags.items()
                             if k.startswith(("join_overflow_",
                                              "join_nonunique_",
+                                             "join_hashsat_",
                                              "exch_overflow_",
                                              "agg_overflow_"))
                             and bool(v)]
@@ -1200,10 +1224,12 @@ class QueryExecution:
                 if not overflow:
                     break
                 self.spans.mark("aqe_overflow", flags=overflow[:8])
-                # unique-build fallback is a correctness re-plan, not a
-                # capacity growth — never gated by the adaptive conf
+                # unique-build / hash-saturation fallbacks are
+                # correctness re-plans, not capacity growth — never
+                # gated by the adaptive conf
                 if not adaptive and any(
-                        not k.startswith("join_nonunique_")
+                        not k.startswith(("join_nonunique_",
+                                          "join_hashsat_"))
                         for k in overflow):
                     raise RuntimeError(
                         f"capacity overflow in {overflow} with adaptive "
@@ -1213,6 +1239,9 @@ class QueryExecution:
                     if k.startswith("join_nonunique_"):
                         self._set_join_nonunique(
                             root, k[len("join_nonunique_"):])
+                    elif k.startswith("join_hashsat_"):
+                        self._set_join_hash_fallback(
+                            root, k[len("join_hashsat_"):])
                     elif k.startswith("join_overflow_"):
                         tag = k[len("join_overflow_"):]
                         total = int(metrics[f"join_rows_{tag}"])
@@ -1258,10 +1287,12 @@ class QueryExecution:
                 store.setdefault(aqe_key, {}).update(converged)
                 while len(store) > 256:
                     store.pop(next(iter(store)))
-        # rtf_build_ms_* is a float (sub-ms filter builds are the
-        # common case) — int() would floor it to a useless 0
+        # *_ms metrics are floats (sub-ms filter/table builds are the
+        # common case) — int() would floor them to a useless 0
         self.last_metrics = {
-            k: (round(float(v), 3) if k.startswith("rtf_build_ms_")
+            k: (round(float(v), 3)
+                if k.startswith(("rtf_build_ms_", "join_build_ms_",
+                                 "join_probe_ms_"))
                 else int(v))
             for k, v in metrics.items()}
         if self._mesh_fallback:

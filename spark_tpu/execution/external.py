@@ -119,9 +119,12 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
         if host_keys is None:
             return None
 
+    from ..io.sources import maybe_prefetch
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
-    chunks = leaf.source.load_chunks(leaf.required_columns,
-                                     leaf.pushed_filters, chunk_rows)
+    chunks = maybe_prefetch(
+        leaf.source.load_chunks(leaf.required_columns,
+                                leaf.pushed_filters, chunk_rows),
+        conf, recovery)
     first = next(iter(chunks), None)
     if first is None:
         return None
@@ -181,6 +184,10 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
             break  # plain LIMIT: enough live rows spilled
         ci += 1
         b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
+    if hasattr(chunks, "close"):
+        # early LIMIT break: release the prefetch worker (it may hold
+        # one decoded chunk against a full queue)
+        chunks.close()
 
     table = pa.concat_tables(spilled, promote_options="permissive")
 

@@ -34,6 +34,20 @@ from ..columnar import Batch, Column
 from ..expr import Vec
 
 
+def canon_key_data(data):
+    """One representative per join-equal float key class: -0.0 -> +0.0
+    and every NaN payload -> the canonical NaN. Join keys compare NaN
+    equal to NaN (the reference's/pandas semantics), so the sort total
+    order (NaN greatest), searchsorted tie-breaking and `==` must all
+    see a single bit pattern per class — applied to BOTH sides before
+    any sort/search/hash. Non-float keys pass through untouched."""
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        return data
+    data = jnp.where(data == 0, jnp.zeros((), data.dtype), data)
+    return jnp.where(jnp.isnan(data), jnp.asarray(np.nan, data.dtype),
+                     data)
+
+
 def build_sorted(key: Vec, sel) -> Tuple:
     """Sort build side by key; invalid rows pushed to the end.
 
@@ -47,13 +61,19 @@ def build_sorted(key: Vec, sel) -> Tuple:
     if key.validity is not None:
         invalid = invalid | (~key.validity).astype(jnp.int8)
     perm0 = jnp.arange(cap, dtype=jnp.int32)
-    inv_s, keys_s, perm = jax.lax.sort((invalid, key.data, perm0), num_keys=2)
+    inv_s, keys_s, perm = jax.lax.sort(
+        (invalid, canon_key_data(key.data), perm0), num_keys=2)
     valid_s = inv_s == 0
     n_valid = jnp.sum(valid_s.astype(jnp.int32))
-    # invalid slots carry arbitrary keys after the valid prefix; overwrite
-    # with +max so the array is globally sorted for binary search
+    # invalid slots carry arbitrary keys after the valid prefix;
+    # overwrite with the sort order's +max so the array stays globally
+    # sorted for binary search. For floats that is the canonical NaN
+    # (valid NaN keys sort ABOVE +inf, so an inf sentinel would break
+    # the order whenever the build has NaN keys and padding); sentinel
+    # runs merging into a valid NaN run is fine — match ranges clip at
+    # n_valid, exactly as they already do for valid +inf keys.
     if jnp.issubdtype(keys_s.dtype, jnp.floating):
-        sentinel = jnp.asarray(np.inf, keys_s.dtype)
+        sentinel = jnp.asarray(np.nan, keys_s.dtype)
     else:
         sentinel = jnp.asarray(np.iinfo(np.dtype(keys_s.dtype)).max, keys_s.dtype)
     keys_s = jnp.where(valid_s, keys_s, sentinel)
@@ -64,8 +84,14 @@ def build_has_duplicates(sorted_keys, valid_sorted):
     """Traced bool: any two valid build rows share a key (adjacent
     check on the sorted keys). Drives the unique-build fast path's
     AQE fallback flag — a table-level property, conservatively True if
-    ANY key repeats (even unmatched ones)."""
+    ANY key repeats (even unmatched ones). NaN groups with NaN, as it
+    does everywhere join keys compare (`==` alone would let duplicate
+    NaN build keys slip past the many-to-many fallback and silently
+    drop their extra matches)."""
     same = sorted_keys[1:] == sorted_keys[:-1]
+    if jnp.issubdtype(sorted_keys.dtype, jnp.floating):
+        same = same | (jnp.isnan(sorted_keys[1:])
+                       & jnp.isnan(sorted_keys[:-1]))
     both = valid_sorted[1:] & valid_sorted[:-1]
     return jnp.any(same & both)
 
@@ -77,10 +103,17 @@ def match_unique(sorted_keys, n_valid, perm, probe_key: Vec, probe_sel):
     reindexing — probe columns pass through untouched.
 
     Returns (build_idx, found)."""
-    lo = jnp.searchsorted(sorted_keys, probe_key.data, side="left",
-                          method="sort")
+    pk = canon_key_data(probe_key.data)
+    lo = jnp.searchsorted(sorted_keys, pk, side="left", method="sort")
     lo = jnp.minimum(lo, sorted_keys.shape[0] - 1).astype(jnp.int32)
-    found = (jnp.take(sorted_keys, lo) == probe_key.data) & (lo < n_valid)
+    hit = jnp.take(sorted_keys, lo)
+    eq = hit == pk
+    if jnp.issubdtype(sorted_keys.dtype, jnp.floating):
+        # NaN keys join equal (the reference's NaN semantics): both
+        # sides are canonicalized, so `lo` lands on the build's NaN run
+        # and only the `NaN == NaN` comparison itself needs the assist
+        eq = eq | (jnp.isnan(hit) & jnp.isnan(pk))
+    found = eq & (lo < n_valid)
     if probe_key.validity is not None:
         found = found & probe_key.validity
     if probe_sel is not None:
@@ -98,10 +131,9 @@ def match_ranges(sorted_keys, n_valid, probe_key: Vec, probe_sel):
     method='sort' matters on TPU: the default 'scan' binary search is
     log2(build) SEQUENTIAL whole-probe gathers (~1.4s for 8M probes,
     measured), while one extra lax.sort is ~100ms."""
-    lo = jnp.searchsorted(sorted_keys, probe_key.data, side="left",
-                          method="sort")
-    hi = jnp.searchsorted(sorted_keys, probe_key.data, side="right",
-                          method="sort")
+    pk = canon_key_data(probe_key.data)
+    lo = jnp.searchsorted(sorted_keys, pk, side="left", method="sort")
+    hi = jnp.searchsorted(sorted_keys, pk, side="right", method="sort")
     lo = jnp.minimum(lo, n_valid).astype(jnp.int32)
     hi = jnp.minimum(hi, n_valid).astype(jnp.int32)
     found = hi > lo

@@ -95,11 +95,16 @@ class ChunkRetrier:
     never worse).
     """
 
-    def __init__(self, conf, recovery: Optional["RecoveryContext"] = None):
+    def __init__(self, conf, recovery: Optional["RecoveryContext"] = None,
+                 site: str = "stream_chunk"):
         self.enabled = bool(conf.get(CHUNK_RETRY_ENABLED_KEY))
         self.max_retries = int(conf.get(CHUNK_RETRY_MAX_KEY))
         self.backoff_ms = float(conf.get(BACKOFF_KEY))
         self.recovery = recovery
+        # chaos seam fired per attempt: "stream_chunk" for the compute
+        # steps, "ingest_prefetch" for the prefetcher's host-decode step
+        # (io/sources.py) — same retry policy, same recovery recording
+        self.site = site
 
     def run(self, step, chunk: int = 0):
         from ..testing import faults
@@ -108,8 +113,13 @@ class ChunkRetrier:
         while True:
             try:
                 # chaos seam: one hit per chunk attempt (replays
-                # re-fire, so multi-fault rules can target retries)
-                faults.fire("stream_chunk")
+                # re-fire, so multi-fault rules can target retries).
+                # Literal site strings: the fault-site lint statically
+                # proves each KNOWN_SITE has a wired fire() seam.
+                if self.site == "ingest_prefetch":
+                    faults.fire("ingest_prefetch")
+                else:
+                    faults.fire("stream_chunk")
                 return step()
             except Exception as e:  # noqa: BLE001 — classified below
                 if not self.enabled or self.max_retries <= 0:

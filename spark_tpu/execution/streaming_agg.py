@@ -42,7 +42,15 @@ def conf_compile_suffix(conf) -> str:
     under other settings."""
     return (f"#k{conf.get('spark_tpu.sql.aggregate.kernelMode')}"
             f"#d{conf.get('spark_tpu.sql.aggregate.maxDirectDomain')}"
-            f"#g{conf.get('spark_tpu.sql.execution.bucketGrowth')}")
+            f"#g{conf.get('spark_tpu.sql.execution.bucketGrowth')}"
+            # join kernel choice + table-shape confs are baked into the
+            # traced probe/build programs (execution/hash_join.py)
+            f"#j{conf.get('spark_tpu.sql.join.kernelMode')}"
+            f"#jl{conf.get('spark_tpu.sql.join.hashLoadFactor')}"
+            f"#jp{conf.get('spark_tpu.sql.join.hashMaxProbe')}"
+            f"#js{conf.get('spark_tpu.sql.join.hashMaxTableSlots')}"
+            f"#jm{conf.get('spark_tpu.sql.join.hashMinProbeRows')}"
+            f"#jr{conf.get('spark_tpu.sql.join.hashProbeBuildRatio')}")
 
 
 #: join types where per-probe-chunk execution is sound: each probe row's
@@ -91,14 +99,16 @@ def _replay_chain(chain: List, ctx, batch: Batch,
 
 
 def apply_join_overflow(flags, metrics, joins) -> bool:
-    """Parse one chunk update's `join_overflow_`/`join_nonunique_` flag
-    families and apply capacity growth / unique-build fallbacks to
-    `joins`. Returns True when anything changed — the caller must re-jit
-    and retry the SAME chunk against the pre-update state. The ONE copy
-    of the chunked-join AQE protocol, shared by every chunk driver
-    (direct stream, partial spill, external collect)."""
+    """Parse one chunk update's `join_overflow_`/`join_nonunique_`/
+    `join_hashsat_` flag families and apply capacity growth /
+    unique-build / hash-kernel fallbacks to `joins`. Returns True when
+    anything changed — the caller must re-jit and retry the SAME chunk
+    against the pre-update state. The ONE copy of the chunked-join AQE
+    protocol, shared by every chunk driver (direct stream, partial
+    spill, external collect)."""
     overflow = [k for k, v in flags.items()
-                if k.startswith(("join_overflow_", "join_nonunique_"))
+                if k.startswith(("join_overflow_", "join_nonunique_",
+                                 "join_hashsat_"))
                 and bool(v)]
     if not overflow:
         return False
@@ -108,6 +118,12 @@ def apply_join_overflow(flags, metrics, joins) -> bool:
             for j in joins:
                 if j.tag == tag:
                     j.unique_build = False
+            continue
+        if k.startswith("join_hashsat_"):
+            tag = k[len("join_hashsat_"):]
+            for j in joins:
+                if j.tag == tag:
+                    j.hash_fallback = False
             continue
         tag = k[len("join_overflow_"):]
         total = int(metrics[f"join_rows_{tag}"])
@@ -182,14 +198,16 @@ def _materialize_subtree(root: P.PhysicalPlan, conf, recovery=None) -> Batch:
         flags, metrics = jax.device_get((flags, metrics))
         overflow = [k for k, v in flags.items()
                     if k.startswith(("join_overflow_", "join_nonunique_",
+                                     "join_hashsat_",
                                      "exch_overflow_", "agg_overflow_"))
                     and bool(v)]
         if not overflow:
             if recovery is not None:
                 recovery.memo_put(("build", id(root)), batch)
             return batch
-        if not adaptive and any(not k.startswith("join_nonunique_")
-                                for k in overflow):
+        if not adaptive and any(
+                not k.startswith(("join_nonunique_", "join_hashsat_"))
+                for k in overflow):
             raise RuntimeError(
                 f"build-side capacity overflow in {overflow} with "
                 f"adaptive re-planning disabled")
@@ -197,6 +215,9 @@ def _materialize_subtree(root: P.PhysicalPlan, conf, recovery=None) -> Batch:
             if k.startswith("join_nonunique_"):
                 QueryExecution._set_join_nonunique(
                     root, k[len("join_nonunique_"):])
+            elif k.startswith("join_hashsat_"):
+                QueryExecution._set_join_hash_fallback(
+                    root, k[len("join_hashsat_"):])
             elif k.startswith("join_overflow_"):
                 tag = k[len("join_overflow_"):]
                 total = int(metrics[f"join_rows_{tag}"])
@@ -283,10 +304,14 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
     """Run agg over a chunked Scan: host ingests record-batch chunks
     (uniform bucketed capacity so the update step compiles once) while the
     device reduces — the double-buffered host->HBM pipeline of SURVEY.md
-    section 2.5 'Async/overlap'."""
+    section 2.5 'Async/overlap' (io/sources.py PrefetchChunkIterator
+    decodes chunk N+1 on a background thread while chunk N computes)."""
+    from ..io.sources import maybe_prefetch
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
-    chunks = leaf.source.load_chunks(leaf.required_columns,
-                                     leaf.pushed_filters, chunk_rows)
+    chunks = maybe_prefetch(
+        leaf.source.load_chunks(leaf.required_columns,
+                                leaf.pushed_filters, chunk_rows),
+        conf, recovery)
     first = next(iter(chunks), None)
     if first is None:
         return None
@@ -413,10 +438,13 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
     prepends the checkpointed partial tables to the spill list."""
     import copy
     import pyarrow as pa
+    from ..io.sources import maybe_prefetch
 
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
-    chunks = leaf.source.load_chunks(leaf.required_columns,
-                                     leaf.pushed_filters, chunk_rows)
+    chunks = maybe_prefetch(
+        leaf.source.load_chunks(leaf.required_columns,
+                                leaf.pushed_filters, chunk_rows),
+        conf, recovery)
     if skip_chunks:
         if not hasattr(chunks, "skip_chunks") or \
                 chunks.skip_chunks(skip_chunks) < skip_chunks:
@@ -680,9 +708,12 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     if _prefer_resident(leaf, conf):
         return None
 
+    from ..io.sources import maybe_prefetch
     n = int(mesh.devices.size)
-    chunks = leaf.source.load_chunks(leaf.required_columns,
-                                     leaf.pushed_filters, chunk_rows)
+    chunks = maybe_prefetch(
+        leaf.source.load_chunks(leaf.required_columns,
+                                leaf.pushed_filters, chunk_rows),
+        conf, recovery)
     first = next(iter(chunks), None)
     if first is None:
         return None

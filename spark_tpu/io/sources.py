@@ -253,15 +253,27 @@ class ChunkIterator:
             skipped += 1
         return skipped
 
-    def __next__(self) -> Batch:
+    def _host_next(self) -> Optional[pa.Table]:
+        """One decoded + dictionary-unified HOST chunk (pa.Table), or
+        None at end of stream. All the per-chunk host work lives here;
+        device placement stays in __next__ — the split the prefetcher
+        (PrefetchChunkIterator) overlaps with device compute."""
         chunk = self._take_chunk()
         if chunk is None:
-            raise StopIteration
+            return None
         if self._capacity is None:
             from ..columnar import bucket_capacity
             self._capacity = bucket_capacity(self._chunk_rows)
-        chunk = self._unifier.unify(chunk)
+        return self._unifier.unify(chunk)
+
+    def _to_device(self, chunk: pa.Table) -> Batch:
         return Batch.from_arrow(chunk, capacity=self._capacity)
+
+    def __next__(self) -> Batch:
+        chunk = self._host_next()
+        if chunk is None:
+            raise StopIteration
+        return self._to_device(chunk)
 
 
 import itertools
@@ -402,3 +414,153 @@ class ParquetSource(TableSource):
             columns=list(required_columns) if required_columns is not None else None,
             filter=ae, batch_size=min(chunk_rows, 1 << 20))
         return ChunkIterator(scanner.to_batches(), chunk_rows)
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered ingest (SURVEY 2.5 "Async/overlap": the shuffle-fetch/
+# compute pipelining seat, host->HBM edition)
+# ---------------------------------------------------------------------------
+
+INGEST_PREFETCH_KEY = "spark_tpu.sql.ingest.prefetch"
+
+
+class PrefetchChunkIterator:
+    """Double-buffered wrapper over a ChunkIterator: a background thread
+    decodes + dictionary-unifies Parquet chunk N+1 into HOST buffers
+    (``ChunkIterator._host_next`` — pyarrow releases the GIL, so the
+    decode genuinely overlaps the consumer's device compute) while the
+    consumer computes chunk N. Bounded to ONE in-flight chunk (a
+    size-1 queue), and device placement stays on the CONSUMER thread,
+    so HBM residency, arbiter leases and the per-chunk retry/checkpoint
+    semantics of the streaming drivers are unchanged.
+
+    Fault behavior: the worker runs each host decode under the SAME
+    per-chunk retry path the compute steps use (``ChunkRetrier`` with
+    the ``ingest_prefetch`` chaos seam) — a transient fault fired at
+    the seam replays exactly one chunk's decode (`rec_chunks_replayed`
+    counts it); a real reader failure poisons the inner iterator as
+    before and surfaces on the consumer thread for the whole-query
+    ladder.
+
+    Observability: ``ingest_stall_ms`` counts time the consumer waited
+    for a chunk (the pipeline failing to hide host decode) and
+    ``ingest_overlap_ms`` counts decode time hidden behind compute —
+    both in the process metrics registry and the `tpch_*` bench
+    sidecars."""
+
+    def __init__(self, inner: ChunkIterator, conf, recovery=None,
+                 metrics=None):
+        from ..execution.recovery import ChunkRetrier
+        self._inner = inner
+        self._retrier = ChunkRetrier(conf, recovery,
+                                     site="ingest_prefetch")
+        self._metrics = metrics
+        self._started = False
+        self._closed = False
+        self._chunk = 0  # next chunk ordinal the worker will decode
+        import queue as _queue
+        import threading
+        import weakref
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=1)
+        # the worker is handed this event (never `self`): when the
+        # consumer abandons the iterator without close() — a fault
+        # unwinding a chunk driver mid-stream — the iterator becomes
+        # unreachable (the thread holds no ref to it), this finalizer
+        # fires, and the worker exits instead of spinning forever on
+        # its full queue holding a decoded chunk
+        self._stop = threading.Event()
+        self._finalizer = weakref.finalize(self, self._stop.set)
+
+    # -- ChunkIterator surface ---------------------------------------------
+
+    @property
+    def dictionaries(self):
+        return self._inner.dictionaries
+
+    def skip_chunks(self, n: int) -> int:
+        """Checkpoint-restore cursor advance; only valid before the
+        worker starts (the drivers skip right after load_chunks)."""
+        if self._started:
+            raise RuntimeError("skip_chunks after prefetch started")
+        skipped = self._inner.skip_chunks(n)
+        self._chunk += skipped
+        return skipped
+
+    def __iter__(self):
+        return self
+
+    # -- pipeline -----------------------------------------------------------
+
+    @staticmethod
+    def _worker(host_next, retrier, q, stop, chunk) -> None:
+        # deliberately a staticmethod over plain arguments: holding a
+        # ref to the iterator would keep it reachable forever and its
+        # abandonment finalizer (see __init__) could never fire
+        import queue as _queue
+        import time as _time
+        while not stop.is_set():
+            t0 = _time.perf_counter()
+            try:
+                item = ("ok", retrier.run(host_next, chunk=chunk),
+                        _time.perf_counter() - t0)
+            except BaseException as e:  # noqa: BLE001 — relayed verbatim
+                item = ("err", e, 0.0)
+            # bounded put that notices close()/abandonment: the worker
+            # must not strand blocked on a full size-1 queue forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    break
+                except _queue.Full:
+                    continue
+            if item[0] == "err" or item[1] is None:
+                return
+            chunk += 1
+
+    def __next__(self) -> Batch:
+        import threading
+        import time as _time
+        if self._closed:
+            raise StopIteration
+        if not self._started:
+            self._started = True
+            threading.Thread(
+                target=self._worker, daemon=True,
+                name="spark-tpu-ingest-prefetch",
+                args=(self._inner._host_next, self._retrier,
+                      self._queue, self._stop, self._chunk)).start()
+        t0 = _time.perf_counter()
+        kind, payload, decode_s = self._queue.get()
+        stall_s = _time.perf_counter() - t0
+        if kind == "err":
+            self._closed = True
+            raise payload
+        if payload is None:
+            self._closed = True
+            raise StopIteration
+        if self._metrics is not None:
+            self._metrics.counter("ingest_stall_ms").inc(
+                round(stall_s * 1e3, 3))
+            self._metrics.counter("ingest_overlap_ms").inc(
+                round(max(0.0, decode_s - stall_s) * 1e3, 3))
+        return self._inner._to_device(payload)
+
+    def close(self) -> None:
+        """Stop the worker (early-exit consumers: external LIMIT)."""
+        self._closed = True
+        self._stop.set()
+
+
+def maybe_prefetch(chunks, conf, recovery=None):
+    """Wrap a chunk stream in the double-buffered prefetcher when
+    ``spark_tpu.sql.ingest.prefetch`` is on. The one entry point every
+    chunk driver (streaming_agg direct/spill/mesh, external collect)
+    routes its `load_chunks` result through — results are identical
+    on/off, only ingest/compute overlap changes."""
+    if not isinstance(chunks, ChunkIterator):
+        return chunks
+    if not bool(conf.get(INGEST_PREFETCH_KEY)):
+        return chunks
+    metrics = getattr(recovery, "metrics", None)
+    return PrefetchChunkIterator(chunks, conf, recovery=recovery,
+                                 metrics=metrics)

@@ -47,6 +47,14 @@ METRIC_PREFIXES = (
     "rtf_tested_",     # runtime-filter probe rows tested
     "rtf_pruned_",     # runtime-filter probe rows pruned
     "rtf_build_ms_",   # runtime-filter trace-time build cost
+    "join_build_ms_",  # hash-join table build cost (trace-time, pmax)
+    "join_probe_ms_",  # hash-join probe-program build cost
+    "join_table_slots_",  # hash-join open-addressing table capacity
+    # ingest pipeline (PrefetchChunkIterator): REGISTRY counters, not
+    # traced per-operator metrics — listed here so the namespace is
+    # closed in one place (consumers key on the prefixes)
+    "ingest_stall_",   # consumer time blocked waiting on host decode
+    "ingest_overlap_",  # host decode time hidden behind device compute
 )
 
 

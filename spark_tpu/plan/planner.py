@@ -63,6 +63,7 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
     agg_counter = [0]
     op_counter = [0]
     rf_counter = [0]
+    cj_counter = [0]
     seen = set()  # creation chains are DAG-shared under rf nodes:
     # tag each node once, or op numbers get burned and overwritten
 
@@ -73,8 +74,15 @@ def _assign_join_tags(plan: P.PhysicalPlan) -> None:
         for c in node.children:
             walk(c)
         if isinstance(node, P.JoinExec):
-            node.tag = f"j{counter[0]}"
-            counter[0] += 1
+            if node.creation_side:
+                # runtime-filter creation semis: a separate namespace,
+                # so injecting one never renumbers the real joins the
+                # strategy-override / AQE-cap channels key on
+                node.tag = f"cj{cj_counter[0]}"
+                cj_counter[0] += 1
+            else:
+                node.tag = f"j{counter[0]}"
+                counter[0] += 1
         elif isinstance(node, P.ExchangeExec):
             node.tag = f"e{ex_counter[0]}"
             ex_counter[0] += 1

@@ -15,6 +15,7 @@ Grammar (comma-separated rules):
     rule  := site ":" fault ":" nth [":" arg]
     site  := scan_load | stage_compile | stage_run | shuffle
              | join_build | mesh | stream_chunk | mesh_checkpoint
+             | ingest_prefetch
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
@@ -39,7 +40,9 @@ executor drops the failed stage's compiled entry on retry, so the retry
 re-traces and the site counts deterministically. `stream_chunk` fires
 once per chunk ATTEMPT inside the streaming drivers' chunk loops
 (execution/recovery.py, so replays re-fire and later hits can target
-retries); `mesh_checkpoint` fires at each mesh-stream snapshot point,
+retries); `ingest_prefetch` fires once per chunk host-decode attempt on
+the prefetcher's background thread (io/sources.py, same per-chunk retry
+path); `mesh_checkpoint` fires at each mesh-stream snapshot point,
 before the snapshot is taken.
 """
 
@@ -58,7 +61,8 @@ INJECT_KEY = "spark_tpu.faults.inject"
 #: set at ARM time — a typo'd site (`stage_rnu`) used to parse fine and
 #: then silently never fire, so the chaos test tested nothing.
 KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
-               "join_build", "mesh", "stream_chunk", "mesh_checkpoint")
+               "join_build", "mesh", "stream_chunk", "mesh_checkpoint",
+               "ingest_prefetch")
 
 #: test-registered extra seams (register_site): code under test may
 #: plant its own fire() points without editing the built-in tuple
@@ -159,22 +163,38 @@ def _parse(spec: str) -> List[_Rule]:
 
 
 class FaultPlan:
-    """Parsed spec + per-site hit counters + a log of fired rules."""
+    """Parsed spec + per-site hit counters + a log of fired rules.
+
+    Hit counting is lock-guarded: `ingest_prefetch` fires from the
+    prefetcher's worker thread and the SQL service runs queries on
+    pool threads, so concurrent fire() calls must not lose counts.
+    Within one thread a site's nth targeting stays deterministic
+    (decode/attempt order); across threads only the COUNT is
+    guaranteed — a rule that must land on a specific chunk of a
+    specific stream should be the only rule armed for its site."""
 
     def __init__(self, spec: str):
         self.spec = spec
         self.rules = _parse(spec)
         self.hits = {}
         self.fired_log: List[Tuple[str, int, str]] = []
+        import threading
+        self._lock = threading.Lock()
 
     def fire(self, site: str) -> None:
-        n = self.hits.get(site, 0) + 1
-        self.hits[site] = n
-        for r in self.rules:
-            if r.fired or r.site != site or r.nth != n:
-                continue
-            r.fired = True
-            self.fired_log.append((site, n, r.fault))
+        with self._lock:
+            n = self.hits.get(site, 0) + 1
+            self.hits[site] = n
+            due = []
+            for r in self.rules:
+                if r.fired or r.site != site or r.nth != n:
+                    continue
+                r.fired = True
+                self.fired_log.append((site, n, r.fault))
+                due.append(r)
+        # fault effects run OUTSIDE the lock: a `slow` sleep must not
+        # serialize unrelated sites' counting
+        for r in due:
             if r.fault == "slow":
                 time.sleep((r.arg if r.arg is not None else 100.0) / 1e3)
                 continue
@@ -182,8 +202,9 @@ class FaultPlan:
                 site, r.fault, _MESSAGES[r.fault].format(site=site, n=n))
 
 
-#: the single armed plan (the driver is single-threaded, like the
-#: session conf activation in executor._activate_conf)
+#: the single armed plan, shared by every thread that reaches a seam
+#: (driver, prefetch workers, service pool threads); its hit counters
+#: are lock-guarded — see FaultPlan
 _PLAN: Optional[FaultPlan] = None
 
 

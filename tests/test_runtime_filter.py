@@ -327,3 +327,100 @@ def test_event_log_carries_rtf_metrics(tables, tmp_path):
     row = summary.iloc[-1]
     assert row["tested"] == 20000 and row["pruned"] > 0
     assert 0.0 < row["ratio"] <= 1.0
+
+
+# -- semi-aware creation sides (runtimeFilter.semiAwareCreation) --------------
+
+SEMI_KEY = "spark_tpu.sql.runtimeFilter.semiAwareCreation"
+
+
+def _count_creation_semis(plan) -> int:
+    from spark_tpu.plan import physical as P
+    seen = [0]
+
+    def walk(n):
+        if isinstance(n, P.JoinExec) and n.how == "left_semi" \
+                and n.creation_side:
+            seen[0] += 1
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return seen[0]
+
+
+@pytest.fixture
+def semi_tables(session):
+    session.conf.set(THRESH_KEY, 1 << 30)
+    # t3 carries BOTH a physical k (disjoint from probe keys) and x
+    # (the real join domain); t2 is the selective other side
+    session.register_table("sa_t3", pd.DataFrame({
+        "k": np.array([100, 101, 102, 103], dtype=np.int64),
+        "x": np.array([1, 2, 3, 4], dtype=np.int64)}))
+    session.register_table("sa_t4", pd.DataFrame({
+        "m": np.array([1, 2, 3, 4], dtype=np.int64)}))
+    session.register_table("sa_t2", pd.DataFrame({
+        "j": np.array([1, 2], dtype=np.int64), "tag": ["a", "b"]}))
+    session.register_table("sa_probe", pd.DataFrame({
+        "k": np.arange(0, 200, dtype=np.int64),
+        "v": np.arange(0, 200, dtype=np.int64)}))
+    return session
+
+
+def _semi_query(session):
+    """Build side passes through an equi-join against selective sa_t2:
+    the creation descent can inherit the tag='a' narrowing."""
+    build = session.table("sa_t3").join(
+        session.table("sa_t2").filter(col("tag") == lit("a")),
+        left_on=col("x"), right_on=col("j"))
+    return session.table("sa_probe").join(
+        build, left_on=col("k"), right_on=col("x"))
+
+
+def _shadowed_query(session):
+    """The descent must pass THROUGH a Project that aliases x onto the
+    name k while the underlying sa_t3 keeps a same-named physical k:
+    name-resolution alone would bind the semi to the wrong column."""
+    inner = session.table("sa_t3").join(
+        session.table("sa_t4"), left_on=col("x"), right_on=col("m"))
+    shadow = inner.select(col("x").alias("k"), col("k").alias("orig"))
+    build = shadow.join(
+        session.table("sa_t2").filter(col("tag") == lit("a")),
+        left_on=col("k"), right_on=col("j"))
+    return session.table("sa_probe").join(
+        build, left_on=col("k"), right_on=col("k"))
+
+
+def test_semi_aware_synthesizes_creation_semi(semi_tables):
+    plan = _semi_query(semi_tables)._qe().executed_plan
+    assert _count_creation_semis(plan) >= 1, plan.tree_string()
+    semi_tables.conf.set(SEMI_KEY, False)
+    plan_off = _semi_query(semi_tables)._qe().executed_plan
+    assert _count_creation_semis(plan_off) == 0, plan_off.tree_string()
+
+
+def test_semi_aware_parity_on_off(semi_tables):
+    on = _semi_query(semi_tables).to_pandas() \
+        .sort_values("v").reset_index(drop=True)
+    semi_tables.conf.set(SEMI_KEY, False)
+    off = _semi_query(semi_tables).to_pandas() \
+        .sort_values("v").reset_index(drop=True)
+    pd.testing.assert_frame_equal(on, off)
+    assert len(on) > 0  # non-vacuous: some probe rows survive
+
+
+def test_semi_aware_skips_shadowing_project(semi_tables):
+    """Regression: a Project aliasing a different expr onto a join-key
+    name (while the relation keeps a same-named physical column) must
+    NOT synthesize a semi — binding by name would build the filter
+    from a non-superset and silently drop matching probe rows."""
+    on = _shadowed_query(semi_tables).to_pandas() \
+        .sort_values("v").reset_index(drop=True)
+    plan = _shadowed_query(semi_tables)._qe().executed_plan
+    # the outer probe filter must not carry an unsound creation semi:
+    # the only sound semi here is the one over the benign inner join
+    semi_tables.conf.set(SEMI_KEY, False)
+    off = _shadowed_query(semi_tables).to_pandas() \
+        .sort_values("v").reset_index(drop=True)
+    pd.testing.assert_frame_equal(on, off)
+    assert len(on) == 1, on  # probe k=1 matches build x=1/tag=a
