@@ -416,6 +416,21 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
             - overlap0, 3)
         extra[f"tpch_{name}_sf{sf:g}_ingest_stall_ms"] = round(
             spark.metrics.counter("ingest_stall_ms").value - stall0, 3)
+        # analyzer self-grading sidecar: mean |error| of the plan-time
+        # size predictions (exchange rows/bytes, join caps, aggregate
+        # group estimates) vs this run's observed metrics — the BENCH
+        # trajectory shows whether the estimators feeding AQE seeds
+        # and runtime-filter sizing are getting tighter or drifting
+        from spark_tpu.history import grade_predictions
+        graded = grade_predictions(qe.plan_predictions or [],
+                                   qe.last_metrics)
+        errs = [abs(g["err_pct"]) for g in graded
+                if g.get("err_pct") is not None]
+        if errs:
+            extra[f"tpch_{name}_sf{sf:g}_pred_err_pct"] = round(
+                sum(errs) / len(errs), 1)
+            misses = sum(1 for g in graded if g["grade"] == "under")
+            extra[f"tpch_{name}_sf{sf:g}_pred_under"] = int(misses)
         # static-analyzer sidecar: findings per query (the BENCH
         # trajectory must show analyzer noise staying at zero on the
         # TPC-H suite; a nonzero count is either a real hazard at this
@@ -441,6 +456,78 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
                   float_rtol=1e-6, float_atol=float_atol)
         extra[f"tpch_{name}_parity"] = True
     return extra
+
+
+def obs_conf_on(base_dir: str) -> dict:
+    """EVERY observability output's conf, pointed at base_dir — the
+    ONE definition of 'all sinks on' shared by this bench section and
+    the preflight stage-5 overhead gate (a new observability key added
+    here is automatically measured by both)."""
+    return {"spark_tpu.sql.eventLog.dir": base_dir + "/ev",
+            "spark_tpu.sql.trace.dir": base_dir + "/tr",
+            "spark_tpu.sql.metrics.sink": "jsonl,prometheus",
+            "spark_tpu.sql.metrics.dir": base_dir + "/m",
+            "spark_tpu.sql.observability.xlaCost": "on",
+            "spark_tpu.sql.observability.shardSpans": "on"}
+
+
+OBS_CONF_OFF = {"spark_tpu.sql.eventLog.dir": "",
+                "spark_tpu.sql.trace.dir": "",
+                "spark_tpu.sql.metrics.sink": "",
+                "spark_tpu.sql.observability.xlaCost": "off",
+                "spark_tpu.sql.observability.shardSpans": "off"}
+
+
+def measure_obs_overhead(spark, run, base_dir: str, best_of: int = 3
+                         ) -> dict:
+    """Warm best-of-N wall-clock of `run` with all observability ON
+    (obs_conf_on) vs OFF (OBS_CONF_OFF); restores the caller's conf.
+    Used by the bench `obs_overhead` section and the preflight gate."""
+    on_conf = obs_conf_on(base_dir)
+    saved = {k: spark.conf.get(k) for k in on_conf}
+
+    def best(fn):
+        fn()  # warm: compile + cache fill
+        times = []
+        for _ in range(best_of):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    try:
+        for k, v in OBS_CONF_OFF.items():
+            spark.conf.set(k, v)
+        off_s = best(run)
+        for k, v in on_conf.items():
+            spark.conf.set(k, v)
+        on_s = best(run)
+    finally:
+        for k, v in saved.items():
+            spark.conf.set(k, v)
+    return {"obs_overhead_ms": round((on_s - off_s) * 1e3, 1),
+            "obs_overhead_pct": round((on_s - off_s) / off_s * 100, 1)
+            if off_s > 0 else None,
+            "obs_off_ms": round(off_s * 1e3, 1),
+            "obs_on_ms": round(on_s * 1e3, 1)}
+
+
+def bench_obs_overhead(spark):
+    """Observability tax on the wall-clock (satellite of the flight
+    -recorder PR): TPC-H Q1 at a small SF, warm, best-of-3, with ALL
+    sinks + xlaCost + per-shard spans ON vs everything OFF. The
+    `obs_overhead_ms` / `obs_overhead_pct` sidecars make the tax
+    visible across BENCH rounds; preflight stage 5 gates it at 10%."""
+    import tempfile
+
+    from spark_tpu.tpch import queries as Q
+    from spark_tpu.tpch.datagen import write_parquet
+
+    base = tempfile.mkdtemp(prefix="bench_obs_")
+    write_parquet(base + "/sf", 0.01)
+    Q.register_tables(spark, base + "/sf")
+    return measure_obs_overhead(
+        spark, lambda: Q.QUERIES["q1"](spark)._qe().collect(), base)
 
 
 def main():
@@ -513,6 +600,10 @@ def main():
     extra.update(run_budgeted(
         "join_microbench", lambda: bench_join_microbench(spark),
         budget))
+    emit_summary()
+    extra.update(run_budgeted(
+        "obs_overhead", lambda: bench_obs_overhead(spark),
+        min(budget, 240)))
     emit_summary()
     # the TPC-H trajectory is the headline consumer of BENCH rounds:
     # give it whatever remains of the total budget (at least its

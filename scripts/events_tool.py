@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Event-log JSONL tooling: schema validation + tail pretty-printer.
+
+The event log is the engine's replay/debug surface (history.py,
+GET /queries, BENCH trajectory analysis); a malformed line silently
+breaks every consumer downstream. This tool makes the schema contract
+enforceable in CI:
+
+    scripts/events_tool.py validate <file-or-dir> [...]
+        Validate every app-*.jsonl line against the versioned schema.
+        Knows every published schema_version (1..3): v3 added the
+        per-shard `shards` records, `plan_tree` and `predictions` —
+        purely additive, so old logs must (and do) validate under
+        their own version's rules. Exits nonzero listing
+        file:line: problem for every violation.
+
+    scripts/events_tool.py tail <file-or-dir> [-n N]
+        Pretty-print the last N events (default 10): query id, status,
+        wall seconds, top spans, fault/straggler notes.
+
+Wired into scripts/preflight.sh after the observability smoke, so a
+schema regression (a field rename, a non-serializable value degrading
+to repr) fails the gate instead of landing in a BENCH round.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3)
+
+#: per-shard record contract (schema v3): field -> allowed types
+#: (shard None marks host-side ingest records)
+_SHARD_FIELDS = {
+    "shard": (int, type(None)),
+    "host": (int,),
+    "phase": (str,),
+    "chunk": (int, type(None)),
+    "rows": (int, type(None)),
+    "bytes": (int, type(None)),
+    "source": (str,),
+}
+
+_SHARD_PHASES = ("ingest", "compute", "transfer")
+
+
+def _problem(out, path, lineno, msg):
+    out.append(f"{path}:{lineno}: {msg}")
+
+
+def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
+    """One event-log record against its own schema_version's rules."""
+    ver = e.get("schema_version")
+    if ver not in KNOWN_SCHEMA_VERSIONS:
+        _problem(out, path, lineno,
+                 f"unknown schema_version {ver!r} "
+                 f"(known: {KNOWN_SCHEMA_VERSIONS})")
+        return
+    for key, types in (("ts", (int, float)), ("status", (str,)),
+                       ("plan", (str,)), ("query_id", (int,))):
+        if not isinstance(e.get(key), types):
+            _problem(out, path, lineno,
+                     f"field {key!r} missing or not {types}")
+    if e.get("status") not in ("ok", "error"):
+        _problem(out, path, lineno, f"bad status {e.get('status')!r}")
+    phases = e.get("phase_times_s")
+    if phases is not None and (
+            not isinstance(phases, dict)
+            or any(not isinstance(v, (int, float))
+                   for v in phases.values())):
+        _problem(out, path, lineno, "phase_times_s must map to numbers")
+    metrics = e.get("metrics")
+    if metrics is not None and not isinstance(metrics, dict):
+        _problem(out, path, lineno, "metrics must be a dict")
+    for s in e.get("spans") or []:
+        if not isinstance(s, dict) or not isinstance(s.get("name"), str) \
+                or not isinstance(s.get("t0_ms"), (int, float)) \
+                or not isinstance(s.get("dur_ms"), (int, float)):
+            _problem(out, path, lineno, f"malformed span record: {s!r}")
+            break
+    for st in e.get("stages") or []:
+        if not isinstance(st, dict) or "key_hash" not in st:
+            _problem(out, path, lineno,
+                     f"malformed stage-cost record: {st!r}")
+            break
+    if ver < 3:
+        for v3_field in ("shards", "predictions", "plan_tree"):
+            if v3_field in e:
+                _problem(out, path, lineno,
+                         f"schema v{ver} record carries v3 field "
+                         f"{v3_field!r}")
+        return
+    for rec in e.get("shards") or []:
+        bad = None
+        if not isinstance(rec, dict):
+            bad = "not a dict"
+        else:
+            for field, types in _SHARD_FIELDS.items():
+                if not isinstance(rec.get(field), types):
+                    bad = f"field {field!r} not {types}"
+                    break
+            if bad is None and rec.get("phase") not in _SHARD_PHASES:
+                bad = f"phase {rec.get('phase')!r} not in {_SHARD_PHASES}"
+            if bad is None and rec.get("shard") is None \
+                    and rec.get("phase") != "ingest":
+                bad = "shard-less record must be phase 'ingest'"
+        if bad is not None:
+            _problem(out, path, lineno,
+                     f"malformed shard record ({bad}): {rec!r}")
+            break
+    for p in e.get("predictions") or []:
+        if not isinstance(p, dict) or not isinstance(p.get("kind"), str) \
+                or not isinstance(p.get("predicted"), (int, float)):
+            _problem(out, path, lineno,
+                     f"malformed prediction record: {p!r}")
+            break
+
+
+def _log_files(targets):
+    files = []
+    for t in targets:
+        if os.path.isdir(t):
+            files.extend(sorted(glob.glob(os.path.join(t, "app-*.jsonl"))))
+        else:
+            files.append(t)
+    return files
+
+
+def validate(targets) -> list:
+    """All violations across the targets as 'path:line: msg' strings."""
+    out: list = []
+    files = _log_files(targets)
+    if not files:
+        out.append(f"no event-log files found under {targets}")
+        return out
+    for path in files:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError as ex:
+                    _problem(out, path, lineno, f"unparseable JSON: {ex}")
+                    continue
+                if not isinstance(e, dict):
+                    _problem(out, path, lineno, "line is not an object")
+                    continue
+                validate_event(e, path, lineno, out)
+    return out
+
+
+def tail(targets, n: int = 10) -> list:
+    """The last n events across the targets, pretty-printed lines."""
+    events = []
+    for path in _log_files(targets):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                events.append((e.get("ts") or 0, os.path.basename(path), e))
+    events.sort(key=lambda t: t[0])
+    lines = []
+    for _, name, e in events[-n:]:
+        phases = e.get("phase_times_s") or {}
+        total = sum(v for v in phases.values()
+                    if isinstance(v, (int, float)))
+        spans = sorted(e.get("spans") or [],
+                       key=lambda s: -(s.get("dur_ms") or 0))[:3]
+        bits = [f"{name} q{e.get('query_id')} {e.get('status')}"
+                f" {total:.3f}s v{e.get('schema_version')}"]
+        if spans:
+            bits.append("spans: " + ", ".join(
+                f"{s['name']}={s['dur_ms']:.0f}ms" for s in spans))
+        shards = e.get("shards") or []
+        if shards:
+            ns = {r.get("shard") for r in shards
+                  if r.get("shard") is not None}
+            bits.append(f"shards: {len(ns)} x "
+                        f"{len(shards) // max(len(ns), 1)} recs")
+        fs = e.get("fault_summary") or {}
+        acts = {k: v for k, v in fs.items()
+                if isinstance(v, int) and k != "events_dropped"}
+        if acts:
+            bits.append(f"faults: {acts}")
+        lines.append("  ".join(bits))
+    return lines
+
+
+def main(argv) -> int:
+    if not argv or argv[0] not in ("validate", "tail"):
+        print(__doc__)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    n = 10
+    if "-n" in rest:
+        i = rest.index("-n")
+        n = int(rest[i + 1])
+        rest = rest[:i] + rest[i + 2:]
+    if not rest:
+        print(f"events_tool {cmd}: need at least one file or directory",
+              file=sys.stderr)
+        return 2
+    if cmd == "validate":
+        problems = validate(rest)
+        if problems:
+            print(f"events_tool validate: FAILED "
+                  f"({len(problems)} problem(s))")
+            for p in problems:
+                print("  " + p)
+            return 1
+        nfiles = len(_log_files(rest))
+        print(f"events_tool validate: ok ({nfiles} file(s))")
+        return 0
+    for line in tail(rest, n):
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -25,6 +25,9 @@ Built-in passes (lints/passes.py):
   of device values in `execution/`/`parallel/` — the PR-1
   `_dict_value_hashes` bug class (hashing a tracer poisons dict
   lookups with trace-order-dependent identities).
+- ``readme-metrics``: every registered METRIC_PREFIXES entry appears
+  in the README metric-name reference table (the operator-facing half
+  of the metric-prefix registration discipline).
 
 Adding a pass: subclass `LintPass`, decorate with `@register_lint`,
 give it `name`, `doc`, optionally override `scope`, implement `check`.

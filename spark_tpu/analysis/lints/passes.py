@@ -216,6 +216,47 @@ class FaultSitePass(LintPass):
 
 
 # ---------------------------------------------------------------------------
+# readme-metrics
+# ---------------------------------------------------------------------------
+
+
+@register_lint
+class ReadmeMetricsPass(LintPass):
+    """Every registered METRIC_PREFIXES entry must appear in the README
+    metric-name reference table: a prefix the docs don't list is a
+    metric family operators can't discover (the README table is the
+    operator-facing half of the registration discipline the
+    metric-prefix pass enforces in code)."""
+
+    name = "readme-metrics"
+    doc = "every METRIC_PREFIXES entry appears in the README table"
+
+    def scope(self, relpath: str) -> bool:
+        return False  # whole-tree pass: finish() reads README.md
+
+    def check(self, tree, relpath, ctx: LintContext):
+        return []
+
+    def finish(self, ctx: LintContext):
+        import os
+        path = os.path.join(ctx.repo, "README.md")
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            return [("README.md", 1, "README.md unreadable")]
+        out = []
+        for prefix in ctx.metric_prefixes:
+            if f"`{prefix}" not in text:
+                out.append(
+                    ("README.md", 1,
+                     f"metric prefix `{prefix}` (METRIC_PREFIXES) is "
+                     f"missing from the README metric-name reference "
+                     f"table"))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # tracer-leak
 # ---------------------------------------------------------------------------
 

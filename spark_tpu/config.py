@@ -479,6 +479,54 @@ MAX_SPANS = register(
         "retry loop must not grow the trace unboundedly; the recorder "
         "counts what it drops).")
 
+SHARD_SPANS = register(
+    "spark_tpu.sql.observability.shardSpans", "auto",
+    doc="Per-shard telemetry for mesh runs (observability/spans.py "
+        "ShardStreamTelemetry): the mesh chunk drivers buffer "
+        "device-side per-shard row counts and flush them at chunk "
+        "boundaries into per-(shard, chunk) timing + bytes records "
+        "(shard id, host, ingest/compute/transfer phases) — no "
+        "host-sync on the hot path. Records land in the event log "
+        "('shards', schema v3), feed the StragglerMonitor and the "
+        "history.shard_summary()/straggler_report() views. 'auto' "
+        "records only when an observability output or a user listener "
+        "is active; 'on' always; 'off' never.",
+    validator=lambda v: v in ("auto", "on", "off"))
+
+MAX_SHARD_RECORDS = register(
+    "spark_tpu.sql.observability.maxShardRecords", 4096,
+    doc="Per-query bound on buffered per-shard telemetry records (a "
+        "long mesh stream over many chunks must not grow the event "
+        "line unboundedly; the recorder counts what it drops).",
+    validator=lambda v: v >= 0)
+
+STRAGGLER_FACTOR = register(
+    "spark_tpu.sql.straggler.factor", 3.0,
+    doc="Straggler detection threshold for the StragglerMonitor "
+        "(observability/straggler.py): a shard whose rolling median "
+        "per-chunk latency exceeds factor x the median of all shards' "
+        "medians is flagged (straggler_flagged counter + on_straggler "
+        "listener event). The speculation-threshold seat of "
+        "spark.speculation.multiplier — detection only; chunk-range "
+        "rebalancing is the elastic-mesh follow-on. <= 0 disables "
+        "detection.",
+    type_=float)
+
+STRAGGLER_MIN_CHUNKS = register(
+    "spark_tpu.sql.straggler.minChunks", 4,
+    doc="Minimum per-shard chunk-latency samples before the "
+        "StragglerMonitor may flag a shard (spark.speculation.quantile "
+        "seat: early chunks are compile/warmup-noisy).",
+    validator=lambda v: v >= 1)
+
+STRAGGLER_MIN_LATENCY_MS = register(
+    "spark_tpu.sql.straggler.minLatencyMs", 10.0,
+    doc="Noise floor for straggler flagging: a shard is only flagged "
+        "when its median per-chunk wait is at least this many "
+        "milliseconds — near-zero medians (every shard keeping up) "
+        "must not flag on ratio alone.",
+    validator=lambda v: v >= 0)
+
 ANALYSIS_ENABLED = register(
     "spark_tpu.sql.analysis.enabled", True,
     doc="Run the pre-compile static analyzer (spark_tpu/analysis/): "
@@ -598,8 +646,18 @@ SERVICE_MAX_SESSIONS = register(
 SERVICE_QUERY_LOG_SIZE = register(
     "spark_tpu.service.queryLogSize", 512,
     doc="Bound on the service's in-memory query status registry "
-        "(GET /queries/<id>): oldest finished records are dropped past "
-        "it.",
+        "(GET /queries/<id> and the GET /queries listing): oldest "
+        "finished records are dropped past it.",
+    validator=lambda v: v >= 1)
+
+SERVICE_HISTORY_SIZE = register(
+    "spark_tpu.service.historySize", 128,
+    doc="Bound on the service's in-memory per-query detail store "
+        "(QueryHistoryStore, fed by the listener bus at query end): "
+        "spans, stage XLA costs, per-shard records and the runtime "
+        "plan tree behind GET /queries/<id>/{timeline,plan}. Detail "
+        "records are much heavier than status records, hence the "
+        "separate (smaller) bound; oldest entries drop past it.",
     validator=lambda v: v >= 1)
 
 MESH_SIZE = register(

@@ -48,8 +48,14 @@ class QueryExecution:
         self.spans = SpanRecorder(
             self.query_id,
             max_spans=int(session.conf.get(
-                "spark_tpu.sql.observability.maxSpans")))
+                "spark_tpu.sql.observability.maxSpans")),
+            max_shard_records=int(session.conf.get(
+                "spark_tpu.sql.observability.maxShardRecords")))
         self.stage_costs: Dict[str, dict] = {}
+        # capacity/size predictions harvested from the planned tree
+        # (analysis/predictions.py) — graded against observed metrics
+        # by history.prediction_report / grade_predictions
+        self.plan_predictions: Optional[list] = None
         # set per execute_batch: False keeps event construction off the
         # hot path when nothing is listening
         self._observe_events = False
@@ -434,6 +440,20 @@ class QueryExecution:
             return True
         return any(not getattr(li, "_builtin", False)
                    for li in self.session.listeners.listeners)
+
+    def _shard_obs_on(self) -> bool:
+        """Gate for per-shard telemetry (mesh runs only): 'on' always,
+        'off' never, 'auto' whenever lifecycle events are observed —
+        the same discipline as xlaCost, so a service/event-logged mesh
+        query gets its flight-recorder records and a bare CLI run pays
+        nothing."""
+        mode = str(self._conf.get(
+            "spark_tpu.sql.observability.shardSpans"))
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        return self._observe_events
 
     def _observe_cost(self) -> bool:
         """Gate for XLA cost/memory capture (it costs a second compile
@@ -1122,6 +1142,19 @@ class QueryExecution:
         # check audits), before any streaming splice or compile. Strict
         # mode raises here, pre-compile.
         self._analyze_plan_phase()
+        # size/capacity predictions off the planned tree (pure host
+        # walk, microseconds): graded post-run against observed metrics
+        # — the analyzer-self-grading loop (history.prediction_report)
+        try:
+            from ..analysis.predictions import predict_plan
+            self.plan_predictions = predict_plan(
+                self.executed_plan, self._conf,
+                int(mesh.devices.size) if mesh is not None else 1)
+        except Exception as e:  # noqa: BLE001 — predictions are advisory
+            import warnings
+            warnings.warn(f"plan prediction walk failed (skipped): "
+                          f"{type(e).__name__}: {e}")
+            self.plan_predictions = None
         root0 = self.executed_plan
         from .python_eval import extract_python_udfs, plan_has_udfs
         if plan_has_udfs(root0):
@@ -1131,7 +1164,18 @@ class QueryExecution:
         if mesh is not None:
             root0 = self._materialize_generates(root0)
         t0 = time.perf_counter()
-        root = self._materialize_streaming(root0, mesh)
+        # per-shard flight recorder (observability/spans.py): the mesh
+        # chunk drivers pick the telemetry up from the context var so
+        # their signatures stay stable; records land on self.spans
+        from ..observability.spans import (ShardStreamTelemetry,
+                                           use_shard_telemetry)
+        telem = None
+        if mesh is not None and self._shard_obs_on():
+            telem = ShardStreamTelemetry(
+                recorder=self.spans, mesh=mesh, query_id=self.query_id,
+                bus=self.session.listeners)
+        with use_shard_telemetry(telem):
+            root = self._materialize_streaming(root0, mesh)
         dt = time.perf_counter() - t0
         if root is not root0:
             # chunked ingest + chunk compute happen inside the splice
@@ -1287,6 +1331,11 @@ class QueryExecution:
                 store.setdefault(aqe_key, {}).update(converged)
                 while len(store) > 256:
                     store.pop(next(iter(store)))
+        # per-shard exchange vectors ([n] arrays riding the metrics
+        # channel) unpack into transfer-phase flight-recorder records;
+        # they never enter last_metrics (scalar columns only)
+        if mesh is not None and self._shard_obs_on():
+            self._record_exchange_shards(metrics, mesh)
         # *_ms metrics are floats (sub-ms filter/table builds are the
         # common case) — int() would floor them to a useless 0
         self.last_metrics = {
@@ -1294,7 +1343,8 @@ class QueryExecution:
                 if k.startswith(("rtf_build_ms_", "join_build_ms_",
                                  "join_probe_ms_"))
                 else int(v))
-            for k, v in metrics.items()}
+            for k, v in metrics.items()
+            if not k.startswith("shard_")}
         if self._mesh_fallback:
             # degraded single-device result of a mesh-planned query:
             # visible next to the device metrics and in the event log
@@ -1413,6 +1463,29 @@ class QueryExecution:
 
         walk(root, ())
 
+    def _record_exchange_shards(self, metrics: Dict, mesh) -> None:
+        """Unpack the exchanges' per-shard row/byte vectors (emitted as
+        one-hot psums by parallel/shuffle.py) into transfer-phase shard
+        records on the span recorder — the exchange half of the flight
+        recorder, next to the chunk drivers' compute/ingest records."""
+        from ..parallel.mesh import shard_hosts
+        import numpy as np
+        hosts = shard_hosts(mesh)
+        for k, v in metrics.items():
+            if not k.startswith("shard_rows_"):
+                continue
+            tag = k[len("shard_rows_"):]
+            rows = np.asarray(v).reshape(-1)
+            nbytes = metrics.get(f"shard_bytes_{tag}")
+            nbytes = np.asarray(nbytes).reshape(-1) \
+                if nbytes is not None else None
+            self.spans.add_shard_records([{
+                "shard": i, "host": hosts[i] if i < len(hosts) else 0,
+                "chunk": None, "phase": "transfer", "rows": int(rows[i]),
+                "bytes": int(nbytes[i]) if nbytes is not None else None,
+                "source": f"exchange:{tag}",
+            } for i in range(len(rows))])
+
     def _post_stage_completed(self, attempt: int, t_att: float,
                               metrics: Dict, overflow: List[str]) -> None:
         from ..observability.listener import StageCompletedEvent
@@ -1447,10 +1520,27 @@ class QueryExecution:
         }
         if error is not None:
             event["error"] = f"{type(error).__name__}: {error}"[:300]
+        if root is not None:
+            try:
+                # runtime-annotated physical tree (rows/caps/hbm notes)
+                # — the GET /queries/<id>/plan payload
+                event["plan_tree"] = self._runtime_tree(root)
+            except Exception:  # noqa: BLE001 — annotation is best-effort
+                pass
         if self.spans.spans:
             event["spans"] = self.spans.to_dicts()
             if self.spans.dropped:
                 event["spans_dropped"] = self.spans.dropped
+        if self.spans.shard_records:
+            # per-shard flight-recorder records (schema v3): mesh chunk
+            # drivers' ingest/compute waits + exchange transfer vectors
+            event["shards"] = list(self.spans.shard_records)
+            if self.spans.shard_dropped:
+                event["shards_dropped"] = self.spans.shard_dropped
+        if self.plan_predictions:
+            # planner/AQE size predictions, graded post-hoc against the
+            # metrics in this same record (history.prediction_report)
+            event["predictions"] = list(self.plan_predictions)
         if self.stage_costs:
             # per-stage XLA cost/memory accounting (history.hbm_summary
             # / compile_summary read these)

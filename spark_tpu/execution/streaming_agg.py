@@ -709,12 +709,17 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
         return None
 
     from ..io.sources import maybe_prefetch
+    from ..observability.spans import current_shard_telemetry
+    import time as _time
     n = int(mesh.devices.size)
+    telem = current_shard_telemetry()
     chunks = maybe_prefetch(
         leaf.source.load_chunks(leaf.required_columns,
                                 leaf.pushed_filters, chunk_rows),
         conf, recovery)
+    t_in0 = _time.perf_counter()
     first = next(iter(chunks), None)
+    t_in1 = _time.perf_counter()
     if first is None:
         return None
     key = (f"stream_mesh:{agg.describe()}:{chunk_rows}:{n}"
@@ -737,7 +742,12 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
                 .astype(jnp.int64) * local.capacity
             new = agg.direct_update_tables(t, local, prep, conf,
                                            row_base=base)
-            return jax.tree_util.tree_map(lambda x: x[None], new)
+            # per-shard telemetry channel: this shard's live rows this
+            # chunk, shape [1] so the sharded stack is [n] with one
+            # device-resident slot per shard (spans.ShardStreamTelemetry
+            # times per-shard readiness off exactly this array)
+            live = jnp.sum(local.selection_mask().astype(jnp.int64))[None]
+            return jax.tree_util.tree_map(lambda x: x[None], new), live
 
         def emit(tables):
             t = jax.tree_util.tree_map(lambda x: x[0], tables)
@@ -745,7 +755,7 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
 
         update_step = jax.jit(shard_map(
             update, mesh=mesh, in_specs=(Psp(AXIS), Psp(AXIS), Psp()),
-            out_specs=Psp(AXIS), check_vma=False),
+            out_specs=(Psp(AXIS), Psp(AXIS)), check_vma=False),
             donate_argnums=(0,))
         emit_step = jax.jit(shard_map(
             emit, mesh=mesh, in_specs=(Psp(AXIS),),
@@ -767,15 +777,25 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     chunk_base = 0
     needs_base = any(a.func.uses_row_base for a in agg.agg_exprs)
 
-    def step(tables, b):
+    def row_width(b):
+        return sum(c.data.dtype.itemsize
+                   + (1 if c.validity is not None else 0)
+                   for c in b.columns.values())
+
+    def step(tables, b, ci):
         nonlocal chunk_base
         padded = pad_batch_to_multiple(b, n)
         if needs_base and chunk_base + padded.capacity >= (1 << 30):
             raise RuntimeError(
                 "first/last over a streamed mesh scan exceeds the 2^30 "
                 "packed-position bound")
-        out = update_step(tables, padded,
-                          jnp.asarray(chunk_base, jnp.int64))
+        t_disp = _time.perf_counter()
+        out, shard_rows = update_step(tables, padded,
+                                      jnp.asarray(chunk_base, jnp.int64))
+        if telem is not None:
+            # hot path stays sync-free: the device array is buffered;
+            # the PREVIOUS chunk's buffer flushes inside this call
+            telem.chunk_dispatched(ci, shard_rows, row_width(b), t_disp)
         chunk_base += padded.capacity
         return out
 
@@ -801,13 +821,20 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     ci = 0
     b = first
     while b is not None:
+        if telem is not None:
+            telem.chunk_ingested(ci, b.capacity,
+                                 b.capacity * row_width(b), t_in0, t_in1)
         check_dicts(b)
-        tables = retrier.run(lambda bb=b: step(tables, bb), chunk=ci)
+        tables = retrier.run(lambda bb=b: step(tables, bb, ci), chunk=ci)
         ci += 1
         if ck_key is not None and ci % every == 0:
             recovery.save_checkpoint(ck_key, ci, snapshot)
+        t_in0 = _time.perf_counter()
         b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
+        t_in1 = _time.perf_counter()
 
+    if telem is not None:
+        telem.finish()  # flush the last chunk's buffered records
     return _with_dict_overrides(emit_step(tables), current_dicts())
 
 

@@ -40,7 +40,8 @@ def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
 
 
 #: event fields kept nested (object columns) rather than flattened
-_NESTED = ("spans", "stages")
+_NESTED = ("spans", "stages", "shards", "predictions",
+           "analysis_findings", "plan_tree")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
@@ -203,6 +204,215 @@ def hbm_summary(events: pd.DataFrame) -> pd.DataFrame:
                      "headroom_ratio": (round(peak / cap, 4)
                                         if cap else None)})
     return pd.DataFrame(rows)
+
+
+def shard_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-(execution, shard, chunk) telemetry from a read_event_log
+    frame: one row per flight-recorder record (schema v3 `shards`) —
+    shard id, host, chunk index, phase (ingest/compute/transfer),
+    rows, bytes, dispatch duration and the per-shard completion wait.
+    The per-shard stage-timeline view the elastic-mesh rebalancer (and
+    straggler_report below) consumes."""
+    rows: List[dict] = []
+    if "shards" not in events.columns:
+        return pd.DataFrame(rows)
+    for _, r in events.iterrows():
+        recs = r.get("shards")
+        if not isinstance(recs, list):
+            continue
+        for s in recs:
+            rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                         "query_id": r.get("query_id"),
+                         "shard": s.get("shard"), "host": s.get("host"),
+                         "chunk": s.get("chunk"), "phase": s.get("phase"),
+                         "source": s.get("source"),
+                         "rows": s.get("rows"), "bytes": s.get("bytes"),
+                         "dur_ms": s.get("dur_ms"),
+                         "wait_ms": s.get("wait_ms")})
+    return pd.DataFrame(rows)
+
+
+def straggler_report(events: pd.DataFrame, factor: Optional[float] = None,
+                     min_chunks: Optional[int] = None,
+                     min_latency_ms: Optional[float] = None
+                     ) -> pd.DataFrame:
+    """Offline straggler detection over a replayed event log: the live
+    StragglerMonitor's detection math (rolling-WINDOW medians per
+    shard, baseline = median of qualified shards' medians, factor
+    threshold over the minLatencyMs floor) applied to the logged
+    per-shard compute waits — one row per (execution, shard).
+
+    Caveat vs the live verdict: thresholds default to the conf
+    REGISTRY values — a logged session's runtime overrides are not in
+    the log, so pass the session's factor/minChunks/minLatencyMs
+    explicitly to reproduce its live verdicts. Shards with fewer than
+    min_chunks samples are reported but excluded from the baseline and
+    never flagged (the live monitor's `ready` gate — the detection
+    rule itself is the SHARED `evaluate_waits`, so the two
+    implementations cannot drift)."""
+    from .config import Conf
+    from .observability.straggler import WINDOW, evaluate_waits
+    conf = Conf()
+    factor = float(conf.get("spark_tpu.sql.straggler.factor")) \
+        if factor is None else float(factor)
+    min_chunks = int(conf.get("spark_tpu.sql.straggler.minChunks")) \
+        if min_chunks is None else int(min_chunks)
+    floor_ms = float(conf.get("spark_tpu.sql.straggler.minLatencyMs")) \
+        if min_latency_ms is None else float(min_latency_ms)
+    shards = shard_summary(events)
+    rows: List[dict] = []
+    if shards.empty:
+        return pd.DataFrame(rows)
+    compute = shards[(shards["phase"] == "compute")
+                     & shards["shard"].notna()]
+    for (app, qid), grp in compute.groupby(["app", "query_id"],
+                                           dropna=False):
+        per_shard = {}
+        hosts = {}
+        for shard, g in grp.groupby("shard"):
+            # the live monitor's rolling window: the LAST
+            # max(WINDOW, min_chunks) waits in chunk order, so long
+            # streams judge recent behavior, not ancient warmup chunks
+            # (and a large min_chunks widens the window rather than
+            # making the ready gate unsatisfiable)
+            g = g.sort_values("chunk")
+            waits = [float(w) for w in g["wait_ms"]
+                     if not pd.isna(w)][-max(WINDOW, min_chunks):]
+            if not waits:
+                continue
+            per_shard[int(shard)] = waits
+            hosts[int(shard)] = g["host"].iloc[0]
+        medians, baseline, flag_now = evaluate_waits(
+            per_shard, factor, min_chunks, floor_ms)
+        for shard, med in sorted(medians.items()):
+            rows.append({
+                "app": app, "query_id": qid, "shard": shard,
+                "host": hosts.get(shard),
+                "chunks": len(per_shard[shard]),
+                "median_wait_ms": round(med, 3),
+                "baseline_ms": (round(baseline, 3)
+                                if baseline is not None else None),
+                "ratio": (round(med / baseline, 3)
+                          if baseline else None),
+                "flagged": shard in flag_now})
+    return pd.DataFrame(rows)
+
+
+#: prediction kind -> observed traced-metric column pattern
+_PRED_OBSERVED = {"exch_rows": "exch_rows_{tag}",
+                  "exch_bytes": "exch_bytes_{tag}",
+                  "join_rows": "join_rows_{tag}",
+                  "agg_groups": "agg_groups_{tag}"}
+
+
+def grade_predictions(predictions, metrics) -> List[dict]:
+    """Grade plan-time size predictions (analysis/predictions.py)
+    against one execution's observed metrics dict. hit = the bound
+    held without gross waste (obs <= pred <= 4*obs); under = the
+    prediction was exceeded (an AQE overflow / undersized filter);
+    over = more than 4x slack (wasted capacity/HBM). Shared by
+    history.prediction_report (event-log replay) and the bench
+    `tpch_*_pred_err_pct` sidecar (live qe)."""
+    out: List[dict] = []
+    for p in predictions or []:
+        kind, tag = p.get("kind"), p.get("tag")
+        pattern = _PRED_OBSERVED.get(kind)
+        if pattern is None or tag is None:
+            continue
+        obs = metrics.get(pattern.format(tag=tag))
+        if obs is None:
+            continue
+        try:
+            obs = float(obs)
+            pred = float(p.get("predicted"))
+        except (TypeError, ValueError):
+            continue
+        if obs <= 0:
+            grade = "hit" if pred <= 8 else "over"
+            err = None
+        else:
+            err = round((pred - obs) / obs * 100.0, 1)
+            grade = ("under" if pred < obs
+                     else "hit" if pred <= 4 * obs else "over")
+        out.append({"kind": kind, "tag": tag, "basis": p.get("basis"),
+                    "predicted": int(pred), "observed": int(obs),
+                    "err_pct": err, "grade": grade})
+    return out
+
+
+#: finding codes whose detail carries a byte/row bound gradeable
+#: against observables: code -> (detail key, what it bounds)
+_FINDING_BOUNDS = {
+    "MESH_FULL_REPLICATION": ("replicated_bytes_bound", "exch_bytes"),
+    "MESH_GATHER_RESULT": ("replicated_bytes_bound", "exch_bytes"),
+    "JOIN_HASH_TABLE_PRESSURE": ("table_bytes", "peak_hbm"),
+    "SPILL_HOST_SYNC": ("estimated_bytes", "peak_hbm"),
+}
+
+
+def prediction_report(events: pd.DataFrame) -> pd.DataFrame:
+    """Analyzer/planner self-grading over a replayed event log: every
+    logged prediction joined against the observed metric of the same
+    tag, plus analyzer findings whose details carry byte bounds graded
+    against observed exchange bytes and stage peak-HBM. One row per
+    graded prediction with hit/over/under and signed error percent."""
+    rows: List[dict] = []
+    metric_skip = ("ts", "plan", "app", "query_id", "status",
+                   "schema_version")
+    for _, r in events.iterrows():
+        metrics = {c: r[c] for c in events.columns
+                   if c not in metric_skip and c not in _NESTED
+                   and not isinstance(r[c], (list, dict))
+                   and pd.notna(r[c])}
+        base = {"ts": r.get("ts"), "app": r.get("app"),
+                "query_id": r.get("query_id")}
+        preds = r.get("predictions") if "predictions" in events.columns \
+            else None
+        if isinstance(preds, list):
+            for g in grade_predictions(preds, metrics):
+                rows.append(dict(base, **g))
+        finds = r.get("analysis_findings") \
+            if "analysis_findings" in events.columns else None
+        stages = r.get("stages") if "stages" in events.columns else None
+        peak = None
+        if isinstance(stages, list):
+            peaks = [s.get("peak_hbm_bytes") for s in stages
+                     if s.get("peak_hbm_bytes") is not None]
+            peak = max(peaks) if peaks else None
+        if isinstance(finds, list):
+            for f in finds:
+                rows.extend(_grade_finding(f, metrics, peak, base))
+    return pd.DataFrame(rows)
+
+
+def _grade_finding(f: dict, metrics: dict, peak_hbm, base: dict
+                   ) -> List[dict]:
+    spec = _FINDING_BOUNDS.get(f.get("code"))
+    if spec is None:
+        return []
+    key, target = spec
+    pred = (f.get("detail") or {}).get(key)
+    if pred is None:
+        return []
+    if target == "peak_hbm":
+        obs = peak_hbm
+        tag = f.get("op")
+    else:
+        # op is "ExchangeExec[e1]" — observed metric keys on the tag
+        op = str(f.get("op") or "")
+        tag = op[op.find("[") + 1:op.rfind("]")] \
+            if "[" in op and "]" in op else None
+        obs = metrics.get(f"exch_bytes_{tag}") if tag else None
+    if obs is None:
+        return []
+    obs, pred = float(obs), float(pred)
+    err = round((pred - obs) / obs * 100.0, 1) if obs > 0 else None
+    # findings state upper BOUNDS: holding (obs <= pred) is a hit even
+    # with slack; an exceeded bound is the miss that matters
+    grade = "under" if pred < obs else "hit"
+    return [dict(base, kind=f"finding:{f.get('code')}", tag=tag,
+                 basis=key, predicted=int(pred), observed=int(obs),
+                 err_pct=err, grade=grade)]
 
 
 def compare_runs(base: pd.DataFrame, other: pd.DataFrame,

@@ -28,16 +28,21 @@ the engine-sized analog, organized the same way:
 
 from .listener import (AnalysisEvent, FaultEvent, ListenerBus,
                        QueryEndEvent, QueryListener, QueryStartEvent,
-                       ServiceEvent, StageCompiledEvent,
-                       StageCompletedEvent)
+                       ServiceEvent, ShardChunkEvent, StageCompiledEvent,
+                       StageCompletedEvent, StragglerEvent)
 from .metrics import (METRIC_PREFIXES, MetricsRegistry,
                       is_registered_metric)
-from .spans import Span, SpanRecorder, to_chrome_trace
+from .spans import (ShardStreamTelemetry, Span, SpanRecorder,
+                    current_shard_telemetry, to_chrome_trace,
+                    use_shard_telemetry)
+from .straggler import StragglerMonitor
 
 __all__ = [
     "AnalysisEvent", "FaultEvent", "ListenerBus", "MetricsRegistry",
     "METRIC_PREFIXES",
     "QueryEndEvent", "QueryListener", "QueryStartEvent", "ServiceEvent",
-    "Span", "SpanRecorder", "StageCompiledEvent", "StageCompletedEvent",
-    "is_registered_metric", "to_chrome_trace",
+    "ShardChunkEvent", "ShardStreamTelemetry", "Span", "SpanRecorder",
+    "StageCompiledEvent", "StageCompletedEvent", "StragglerEvent",
+    "StragglerMonitor", "current_shard_telemetry",
+    "is_registered_metric", "to_chrome_trace", "use_shard_telemetry",
 ]

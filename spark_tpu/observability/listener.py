@@ -94,6 +94,37 @@ class ServiceEvent:
 
 
 @dataclass
+class ShardChunkEvent:
+    """Posted by the mesh chunk drivers' per-shard telemetry
+    (ShardStreamTelemetry) at each chunk-boundary flush: one record per
+    (shard, chunk) with rows/bytes and the per-shard completion wait.
+    The StragglerMonitor consumes this stream."""
+
+    query_id: int
+    ts: float
+    chunk: int
+    records: List[Dict] = field(default_factory=list)
+
+
+@dataclass
+class StragglerEvent:
+    """Posted by the StragglerMonitor when a shard's rolling median
+    per-chunk wait exceeds `spark_tpu.sql.straggler.factor` x the
+    all-shard baseline (after `straggler.minChunks` samples). The
+    detection half of straggler mitigation — the elastic-mesh
+    rebalancer subscribes here."""
+
+    query_id: int
+    ts: float
+    shard: int
+    host: int
+    median_ms: float
+    baseline_ms: float
+    chunks: int
+    factor: float
+
+
+@dataclass
 class QueryEndEvent:
     """Posted when an execution finishes (status 'ok') or fails past
     recovery (status 'error'). `event` is the full event-log record —
@@ -109,7 +140,7 @@ class QueryEndEvent:
 #: callback names the bus will deliver (anything else is a bug)
 CALLBACKS = ("on_query_start", "on_analysis", "on_stage_compiled",
              "on_stage_completed", "on_fault", "on_query_end",
-             "on_service")
+             "on_service", "on_shard_records", "on_straggler")
 
 
 class QueryListener:
@@ -140,6 +171,12 @@ class QueryListener:
         pass
 
     def on_service(self, event: ServiceEvent) -> None:
+        pass
+
+    def on_shard_records(self, event: ShardChunkEvent) -> None:
+        pass
+
+    def on_straggler(self, event: StragglerEvent) -> None:
         pass
 
 

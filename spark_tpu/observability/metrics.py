@@ -50,11 +50,19 @@ METRIC_PREFIXES = (
     "join_build_ms_",  # hash-join table build cost (trace-time, pmax)
     "join_probe_ms_",  # hash-join probe-program build cost
     "join_table_slots_",  # hash-join open-addressing table capacity
+    # per-shard telemetry ([n] arrays: one slot per mesh position, the
+    # executor unpacks them into event-log `shards` records; consumer:
+    # history.shard_summary / straggler_report)
+    "shard_rows_",     # per-shard routed/processed live rows
+    "shard_bytes_",    # per-shard routed payload bytes
     # ingest pipeline (PrefetchChunkIterator): REGISTRY counters, not
     # traced per-operator metrics — listed here so the namespace is
     # closed in one place (consumers key on the prefixes)
     "ingest_stall_",   # consumer time blocked waiting on host decode
     "ingest_overlap_",  # host decode time hidden behind device compute
+    # straggler detection (observability/straggler.py): REGISTRY
+    # counter, listed for namespace closure like the ingest pair
+    "straggler_",      # straggler_flagged: shards flagged this process
 )
 
 

@@ -17,7 +17,11 @@ import warnings
 from .listener import QueryEndEvent, QueryListener
 from .spans import to_chrome_trace
 
-EVENT_LOG_SCHEMA_VERSION = 2
+# v3: per-shard telemetry (`shards` records + `shards_dropped`), the
+# runtime-annotated `plan_tree`, and `predictions` (analyzer
+# self-grading). Purely additive — v2 logs replay unchanged
+# (scripts/events_tool.py validates both).
+EVENT_LOG_SCHEMA_VERSION = 3
 
 
 def json_default(o):
@@ -195,10 +199,13 @@ class MetricsSinkListener(QueryListener):
 
 def install_default_listeners(session) -> None:
     """Register the built-in subscribers on a session's bus (order
-    matters only for determinism: event log, trace, metrics)."""
+    matters only for determinism: event log, trace, metrics,
+    straggler monitor)."""
+    from .straggler import StragglerMonitor
     session.listeners.register(EventLogListener(session))
     session.listeners.register(ChromeTraceListener(session))
     session.listeners.register(MetricsSinkListener(session))
+    session.listeners.register(StragglerMonitor(session))
 
 
 def make_app_id() -> str:

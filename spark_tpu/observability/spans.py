@@ -13,6 +13,7 @@ from __future__ import annotations
 import contextlib
 import os
 import time
+from contextvars import ContextVar
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -32,15 +33,34 @@ class Span:
 class SpanRecorder:
     """Bounded span list for one QueryExecution (query_id = trace tid)."""
 
-    def __init__(self, query_id: int, max_spans: int = 1000):
+    def __init__(self, query_id: int, max_spans: int = 1000,
+                 max_shard_records: int = 4096):
         self.query_id = query_id
         self.max_spans = max_spans
         self.spans: List[Span] = []
         #: spans dropped past the bound (surfaced so truncation is
         #: visible, never silent)
         self.dropped = 0
+        #: per-shard telemetry records (mesh runs): dicts with shard,
+        #: host, chunk, phase, rows, bytes, t0_ms, dur_ms, wait_ms,
+        #: source — the event log's `shards` field (schema v3)
+        self.shard_records: List[Dict] = []
+        self.max_shard_records = max_shard_records
+        self.shard_dropped = 0
         self._anchor_wall = time.time()
         self._anchor_perf = time.perf_counter()
+
+    def add_shard_records(self, records: List[Dict]) -> None:
+        room = self.max_shard_records - len(self.shard_records)
+        if room < len(records):
+            self.shard_dropped += len(records) - max(room, 0)
+            records = records[:max(room, 0)]
+        self.shard_records.extend(records)
+
+    def rel_ms(self, t_perf: float) -> float:
+        """Perf-counter time as milliseconds since the recorder anchor
+        (the shared origin of span t0_ms and shard-record t0_ms)."""
+        return round((t_perf - self._anchor_perf) * 1e3, 3)
 
     def record(self, name: str, t0: float, t1: Optional[float] = None,
                **attrs) -> None:
@@ -102,3 +122,171 @@ def to_chrome_trace(recorder: SpanRecorder,
             ev["args"] = {k: v for k, v in s.attrs.items()}
         events.append(ev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Per-shard telemetry (mesh chunk drivers)
+# ---------------------------------------------------------------------------
+
+#: the executor installs the current execution's telemetry here around
+#: the streaming-materialization phase; the mesh chunk drivers read it
+#: (the same context-threading pattern the arbiter's enter_query uses,
+#: so driver signatures — which tests monkeypatch — stay unchanged)
+_SHARD_TELEMETRY: ContextVar[Optional["ShardStreamTelemetry"]] = \
+    ContextVar("spark_tpu_shard_telemetry", default=None)
+
+
+def current_shard_telemetry() -> Optional["ShardStreamTelemetry"]:
+    return _SHARD_TELEMETRY.get()
+
+
+@contextlib.contextmanager
+def use_shard_telemetry(telem: Optional["ShardStreamTelemetry"]):
+    token = _SHARD_TELEMETRY.set(telem)
+    try:
+        yield telem
+    finally:
+        try:
+            if telem is not None:
+                telem.finish()
+        finally:
+            # the reset must survive a raising finish: a stale context
+            # var would leak this query's telemetry into the next
+            _SHARD_TELEMETRY.reset(token)
+
+
+class ShardStreamTelemetry:
+    """Per-shard/per-chunk flight recorder for the mesh chunk drivers.
+
+    The hot path stays sync-free: each chunk dispatch hands over the
+    step's per-shard live-row array (a device-resident [n] int64,
+    sharded on the data axis — appending it costs no transfer), and the
+    PREVIOUS chunk's buffer is flushed at the next chunk boundary,
+    where the driver is already doing host work (Parquet decode of the
+    next chunk). A flush walks the array's addressable shards in mesh
+    order, timing the block-until-ready wait it pays on each — the
+    per-shard completion profile: a straggling device inflates its own
+    wait window while shards that kept up read back instantly — then
+    pulls the row counts in one device_get and emits one record per
+    (shard, chunk) plus a host-side ingest record. Records land on the
+    SpanRecorder (event-log `shards`, schema v3) and are posted on the
+    listener bus (`on_shard_records`) for the StragglerMonitor.
+
+    The `shard_chunk` chaos seam fires once per (chunk, shard) inside
+    the timed wait window, so an injected `slow` fault models exactly
+    one straggling shard (hit ordinal = chunk * n_shards + shard + 1).
+    """
+
+    def __init__(self, recorder: SpanRecorder, mesh, query_id: int,
+                 bus=None, source: str = "stream_mesh"):
+        from ..parallel.mesh import shard_hosts
+        self.recorder = recorder
+        self.query_id = query_id
+        self.bus = bus
+        self.source = source
+        self.hosts = shard_hosts(mesh)
+        self.n = len(self.hosts)
+        self._dev_pos = {d.id: i for i, d in enumerate(mesh.devices.flat)}
+        #: (chunk, shard_rows device array, row_width, t_dispatch0)
+        self._pending: Optional[tuple] = None
+
+    # -- driver-facing hooks (hot path: no device sync) ---------------------
+
+    def chunk_ingested(self, chunk: int, rows: int, nbytes: int,
+                       t0: float, t1: float) -> None:
+        """Host-side decode of one chunk (the ingest phase): recorded
+        directly — it is already host wall-clock, nothing to flush."""
+        import jax
+        self.recorder.add_shard_records([{
+            "shard": None, "host": int(jax.process_index()),
+            "chunk": int(chunk), "phase": "ingest", "rows": int(rows),
+            "bytes": int(nbytes), "t0_ms": self.recorder.rel_ms(t0),
+            "dur_ms": round((t1 - t0) * 1e3, 3), "source": self.source}])
+
+    def chunk_dispatched(self, chunk: int, shard_rows, row_width: int,
+                         t_dispatch: float) -> None:
+        """Buffer one chunk's per-shard live-row array (device-side;
+        no sync) after flushing the previous chunk's buffer."""
+        if self._pending is not None and self._pending[0] == int(chunk):
+            # retried attempt of the SAME chunk (ChunkRetrier replay):
+            # discard the failed attempt's buffer — flushing it would
+            # emit duplicate (shard, chunk) records (double-counting
+            # row totals, skewing straggler medians) off an array the
+            # failed dispatch may have poisoned
+            self._pending = None
+        self._flush_pending()
+        self._pending = (int(chunk), shard_rows, int(row_width),
+                         t_dispatch)
+
+    def finish(self) -> None:
+        self._flush_pending()
+
+    # -- flush (chunk boundary / stream end) --------------------------------
+
+    def _shard_pieces(self, arr) -> List:
+        """The array's addressable shards in mesh-axis order (None
+        placeholders for shards this process cannot see — multi-host)."""
+        pieces = [None] * self.n
+        for s in getattr(arr, "addressable_shards", ()) or ():
+            i = self._dev_pos.get(getattr(s.device, "id", None))
+            if i is not None:
+                pieces[i] = s.data
+        return pieces
+
+    def _flush_pending(self) -> None:
+        """Flush the buffered chunk into records. The WHOLE flush is
+        failure-isolated: an async device error surfacing through
+        block_until_ready here must neither fail the query nor mask
+        the stream's own exception (finish() runs on unwind paths) —
+        the dispatch that owns the error re-raises it at the engine's
+        own sync point, where the failure ladder classifies it. A
+        raising fault injected at the shard_chunk seam is likewise
+        swallowed: the seam models a SLOW shard, not a dead one."""
+        if self._pending is None:
+            return
+        try:
+            self._flush_pending_inner()
+        except Exception as e:  # noqa: BLE001 — never fail the query
+            import warnings
+            warnings.warn(f"per-shard telemetry flush failed (records "
+                          f"dropped): {type(e).__name__}: {e}")
+
+    def _flush_pending_inner(self) -> None:
+        import jax
+        from ..testing import faults
+        chunk, arr, row_width, t0 = self._pending
+        self._pending = None
+        pieces = self._shard_pieces(arr)
+        waits = []
+        for i in range(self.n):
+            w0 = time.perf_counter()
+            # chaos seam INSIDE the timed window: `slow` on hit
+            # chunk*n + shard + 1 models that one shard straggling
+            faults.fire("shard_chunk")
+            if pieces[i] is not None:
+                jax.block_until_ready(pieces[i])
+            waits.append((time.perf_counter() - w0) * 1e3)
+        t_done = time.perf_counter()
+        # read each shard's count from its ADDRESSABLE piece — a
+        # device_get of the global array raises on a multi-host mesh
+        # (non-addressable devices). Shards owned by other processes
+        # get no record HERE: every host runs this same driver and
+        # records its own shards, so the fleet's logs union to full
+        # coverage instead of each host fabricating remote waits.
+        rows = [None if pieces[i] is None
+                else int(jax.device_get(pieces[i]).reshape(-1)[0])
+                for i in range(self.n)]
+        records = [{
+            "shard": i, "host": self.hosts[i], "chunk": chunk,
+            "phase": "compute", "rows": rows[i],
+            "bytes": rows[i] * row_width,
+            "t0_ms": self.recorder.rel_ms(t0),
+            "dur_ms": round((t_done - t0) * 1e3, 3),
+            "wait_ms": round(waits[i], 3), "source": self.source,
+        } for i in range(self.n) if rows[i] is not None]
+        self.recorder.add_shard_records(records)
+        if self.bus is not None:
+            from .listener import ShardChunkEvent
+            self.bus.post("on_shard_records", ShardChunkEvent(
+                query_id=self.query_id, ts=time.time(), chunk=chunk,
+                records=records))

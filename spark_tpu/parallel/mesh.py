@@ -56,6 +56,16 @@ def get_mesh(conf) -> Optional[Mesh]:
     return Mesh(np.array(devices[:n]), (AXIS,))
 
 
+def shard_hosts(mesh: Mesh) -> list:
+    """Per-shard host identity for telemetry records: the JAX process
+    index owning each data-axis position's device (0 for every shard on
+    a single-host/virtual-CPU mesh). Multi-host straggler reports need
+    the shard -> host mapping to name the slow MACHINE, not just the
+    slow mesh position."""
+    return [int(getattr(d, "process_index", 0) or 0)
+            for d in mesh.devices.flat]
+
+
 def init_distributed(conf) -> int:
     """Multi-host bring-up: initialize the JAX distributed runtime so
     `jax.devices()` spans every host's chips and the engine's collectives

@@ -168,6 +168,14 @@ def _exchange_by_target(batch: Batch, tgt, ctx, block: int,
                     + (1 if c.validity is not None else 0)
                     for c in batch.columns.values())
     ctx.add_metric(f"exch_bytes_{tag}", live_rows * row_width)
+    # per-shard telemetry: one-hot at this shard's mesh position; the
+    # executor's psum reduction turns the stack into a replicated [n]
+    # per-shard vector — no all_gather, no host sync (the flight
+    # recorder's transfer-phase records come from exactly this)
+    shard_hot = jnp.zeros((n,), jnp.int64).at[
+        jax.lax.axis_index(axis)].set(live_rows)
+    ctx.add_metric(f"shard_rows_{tag}", shard_hot)
+    ctx.add_metric(f"shard_bytes_{tag}", shard_hot * row_width)
     ctx.add_flag(f"exch_overflow_{tag}", max_count > block)
 
     def send_recv(x, fill=0):

@@ -13,8 +13,16 @@ Endpoints:
   IPC stream with ``format=arrow``) plus the service query id; async
   returns 202 with the id immediately. Admission rejections are HTTP
   429 and queue timeouts 503, both with structured JSON bodies.
+- ``GET /queries``: paginated listing of the query registry (newest
+  first; ``?offset=&limit=&status=&session=``) — the live history UI
+  seat, no JSONL scraping required.
 - ``GET /queries/<id>``: the query's status record, fed by the
   listener bus (engine query id, phase times, fault events, status).
+- ``GET /queries/<id>/timeline``: post-execution detail from the
+  bounded QueryHistoryStore — per-phase spans, per-stage XLA
+  flops/bytes/peak-HBM, per-shard flight-recorder records.
+- ``GET /queries/<id>/plan``: the submitted SQL plus the describe()
+  fingerprint and the runtime-annotated physical tree.
 - ``GET /metrics``: the shared metrics registry in Prometheus text
   exposition (queries, admission, arbiter, compile/result caches).
 - ``GET /healthz``: liveness + pool/admission/arbiter stats.
@@ -39,6 +47,8 @@ from .admission import (AdmissionController, AdmissionError,
                         AdmissionRejected, AdmissionTimeout)
 from .arbiter import (DeviceResourceArbiter, get_arbiter, install_arbiter)
 from .pool import PoolExhausted, SessionPool
+from .query_history import (HISTORY_SIZE_KEY, QueryHistoryStore,
+                            detail_from_event)
 
 MAX_CONCURRENT_KEY = "spark_tpu.service.maxConcurrent"
 QUEUE_DEPTH_KEY = "spark_tpu.service.queueDepth"
@@ -53,10 +63,14 @@ QUERY_LOG_KEY = "spark_tpu.service.queryLogSize"
 class _StatusListener(QueryListener):
     """Pooled-session subscriber feeding `GET /queries/<id>`: engine
     lifecycle events resolve against the service record currently
-    leased onto that session (sessions execute one query at a time)."""
+    leased onto that session (sessions execute one query at a time).
+    At query end the full detail record (spans, stage costs, per-shard
+    records, runtime plan tree) lands in the service's
+    QueryHistoryStore for `GET /queries/<id>/{timeline,plan}`."""
 
-    def __init__(self, entry):
+    def __init__(self, entry, history: Optional[QueryHistoryStore] = None):
         self._entry = entry
+        self._history = history
 
     def _record(self):
         return self._entry.current_record
@@ -77,13 +91,20 @@ class _StatusListener(QueryListener):
 
     def on_query_end(self, event) -> None:
         r = self._record()
-        if r is not None:
-            ev = event.event or {}
+        if r is None:
+            return
+        ev = event.event or {}
+        # OUTER execution only: nested subquery/CTE executions post
+        # their own end events, which must not overwrite the detail of
+        # the query the client submitted
+        if event.query_id == r.get("engine_query_id"):
             r["phase_times_s"] = ev.get("phase_times_s")
             if ev.get("fault_summary"):
                 r["fault_summary"] = {
                     k: v for k, v in ev["fault_summary"].items()
                     if isinstance(v, (int, float))}
+            if self._history is not None:
+                self._history.put(r["id"], detail_from_event(event))
 
 
 class SqlService:
@@ -101,9 +122,15 @@ class SqlService:
             int(self.conf.get(HBM_BUDGET_KEY)), metrics=self.metrics,
             result_cache_bytes=int(self.conf.get(RESULT_CACHE_KEY)))
         self._installed_arbiter = False
+        #: per-query detail store behind GET /queries/<id>/{timeline,
+        #: plan}, fed by the pooled sessions' status listener
+        self.history = QueryHistoryStore(
+            int(self.conf.get(HISTORY_SIZE_KEY)))
         self.pool = SessionPool(
             self.conf, self.metrics, self.arbiter,
-            init_session=init_session, make_listener=_StatusListener)
+            init_session=init_session,
+            make_listener=lambda entry: _StatusListener(entry,
+                                                        self.history))
         self.admission = AdmissionController(
             int(self.conf.get(MAX_CONCURRENT_KEY)),
             int(self.conf.get(QUEUE_DEPTH_KEY)),
@@ -369,6 +396,77 @@ class SqlService:
 
     # -- endpoints' data ----------------------------------------------------
 
+    #: status-record fields exposed in the GET /queries listing (the
+    #: full record stays behind GET /queries/<id>)
+    _LIST_FIELDS = ("id", "sql", "session", "status", "submitted_ts",
+                    "started_ts", "finished_ts", "elapsed_ms",
+                    "row_count", "engine_query_id")
+
+    def query_listing(self, offset: int = 0, limit: int = 50,
+                      status: Optional[str] = None,
+                      session: Optional[str] = None) -> Dict:
+        """Paginated query listing, newest first, optionally filtered
+        by status / session name. Bounded by the same queryLogSize
+        registry GET /queries/<id> reads from."""
+        offset = max(0, int(offset))
+        limit = max(1, min(int(limit), 500))
+        with self._records_lock:
+            # C-level copies under the lock: worker threads mutate the
+            # live record dicts mid-listing
+            records = [dict(r) for r in self._records.values()]
+        records.reverse()  # insertion order == submission order
+        if status is not None:
+            records = [r for r in records if r.get("status") == status]
+        if session is not None:
+            records = [r for r in records if r.get("session") == session]
+        page = records[offset:offset + limit]
+        out = {"queries": [{k: r.get(k) for k in self._LIST_FIELDS
+                            if k in r} for r in page],
+               "total": len(records), "offset": offset, "limit": limit}
+        if offset + limit < len(records):
+            out["next_offset"] = offset + limit
+        return out
+
+    def query_timeline(self, query_id: str) -> Optional[Dict]:
+        """Per-query flight-recorder view: phase spans + per-stage XLA
+        flops/bytes/peak-HBM + per-shard records, from the history
+        store (None when the id is unknown; a known-but-still-running
+        query serves its status record with empty detail)."""
+        rec = self.query_snapshot(query_id)
+        if rec is None:
+            return None
+        detail = self.history.get(query_id) or {}
+        return {"query_id": query_id,
+                "status": rec.get("status"),
+                "session": rec.get("session"),
+                "engine_query_id": (rec.get("engine_query_id")
+                                    or detail.get("engine_query_id")),
+                "elapsed_ms": rec.get("elapsed_ms"),
+                "phase_times_s": detail.get("phase_times_s")
+                or rec.get("phase_times_s"),
+                "spans": detail.get("spans") or [],
+                "stages": detail.get("stages") or [],
+                "shards": detail.get("shards") or [],
+                "metrics": detail.get("metrics") or {},
+                "predictions": detail.get("predictions") or [],
+                "fault_summary": (detail.get("fault_summary")
+                                  or rec.get("fault_summary"))}
+
+    def query_plan(self, query_id: str) -> Optional[Dict]:
+        """Explain view: the submitted SQL, the describe() fingerprint
+        and the runtime-annotated physical tree."""
+        rec = self.query_snapshot(query_id)
+        if rec is None:
+            return None
+        detail = self.history.get(query_id) or {}
+        return {"query_id": query_id,
+                "status": rec.get("status"),
+                "sql": rec.get("sql"),
+                "plan": detail.get("plan"),
+                "physical": detail.get("plan_tree"),
+                "analysis_findings": detail.get("analysis_findings")
+                or []}
+
     def metrics_text(self) -> str:
         from ..observability.metrics import prometheus_text
         return prometheus_text(self.metrics.snapshot())
@@ -464,20 +562,45 @@ def _make_handler(service: SqlService):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            path = self.path.split("?", 1)[0]
+            from urllib.parse import parse_qs
+            path, _, query = self.path.partition("?")
             if path == "/healthz":
                 self._send_json(200, service.health())
             elif path == "/metrics":
                 self._send_text(
                     200, service.metrics_text(),
                     "text/plain; version=0.0.4; charset=utf-8")
+            elif path in ("/queries", "/queries/"):
+                qs = parse_qs(query)
+
+                def arg(name, default=None):
+                    v = qs.get(name)
+                    return v[0] if v else default
+
+                try:
+                    listing = service.query_listing(
+                        offset=int(arg("offset", 0)),
+                        limit=int(arg("limit", 50)),
+                        status=arg("status"), session=arg("session"))
+                except (TypeError, ValueError) as e:
+                    self._send_json(400, {"error": "BAD_REQUEST",
+                                          "message": str(e)[:200]})
+                    return
+                self._send_json(200, listing)
             elif path.startswith("/queries/"):
-                rec = service.query_snapshot(path[len("/queries/"):])
-                if rec is None:
+                rest = path[len("/queries/"):]
+                if rest.endswith("/timeline"):
+                    payload = service.query_timeline(
+                        rest[:-len("/timeline")])
+                elif rest.endswith("/plan"):
+                    payload = service.query_plan(rest[:-len("/plan")])
+                else:
+                    payload = service.query_snapshot(rest)
+                if payload is None:
                     self._send_json(404, {"error": "NOT_FOUND",
                                           "message": path})
                 else:
-                    self._send_json(200, rec)
+                    self._send_json(200, payload)
             else:
                 self._send_json(404, {"error": "NOT_FOUND",
                                       "message": path})

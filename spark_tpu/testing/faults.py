@@ -15,7 +15,7 @@ Grammar (comma-separated rules):
     rule  := site ":" fault ":" nth [":" arg]
     site  := scan_load | stage_compile | stage_run | shuffle
              | join_build | mesh | stream_chunk | mesh_checkpoint
-             | ingest_prefetch
+             | ingest_prefetch | shard_chunk
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
@@ -43,7 +43,11 @@ once per chunk ATTEMPT inside the streaming drivers' chunk loops
 retries); `ingest_prefetch` fires once per chunk host-decode attempt on
 the prefetcher's background thread (io/sources.py, same per-chunk retry
 path); `mesh_checkpoint` fires at each mesh-stream snapshot point,
-before the snapshot is taken.
+before the snapshot is taken; `shard_chunk` fires once per
+(chunk, shard) inside the per-shard telemetry's timed wait window
+(observability/spans.py — hit ordinal chunk * n_shards + shard + 1),
+so a `slow` rule models exactly one straggling shard for the
+StragglerMonitor chaos tests.
 """
 
 from __future__ import annotations
@@ -62,7 +66,7 @@ INJECT_KEY = "spark_tpu.faults.inject"
 #: then silently never fire, so the chaos test tested nothing.
 KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "join_build", "mesh", "stream_chunk", "mesh_checkpoint",
-               "ingest_prefetch")
+               "ingest_prefetch", "shard_chunk")
 
 #: test-registered extra seams (register_site): code under test may
 #: plant its own fire() points without editing the built-in tuple

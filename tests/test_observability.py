@@ -314,7 +314,7 @@ def test_event_log_schema_and_unique_filename(session, tmp_path):
     assert files[0] != f"app-{os.getpid()}.jsonl"
     line = json.loads(open(os.path.join(log_dir, files[0])).read()
                       .splitlines()[-1])
-    assert line["schema_version"] == 2
+    assert line["schema_version"] == 3
     assert line["status"] == "ok"
     assert line["query_id"] >= 1
 
@@ -411,6 +411,283 @@ def test_compare_runs_on_synthetic_logs(tmp_path):
     row = cmp[cmp["column"] == "phase_execution_s"].iloc[0]
     assert row["base"] == 2.0 and row["other"] == 1.0
     assert row["delta"] == -1.0 and row["ratio"] == 0.5
+
+
+# -- per-shard telemetry + straggler detection -------------------------------
+
+MESH_KEY = "spark_tpu.sql.mesh.size"
+CHUNK_KEY = "spark_tpu.sql.execution.streamingChunkRows"
+CACHE_KEY = "spark_tpu.sql.io.deviceCacheBytes"
+SHARD_SPANS_KEY = "spark_tpu.sql.observability.shardSpans"
+
+
+def _mesh_stream_qe(session, n_rows=5000, chunk=1024, name="shard_obs_t"):
+    """A mesh streamed-aggregate execution with per-shard spans on."""
+    pdf = pd.DataFrame({"v": np.arange(n_rows, dtype=np.int64)})
+    session.register_table(name, pdf)
+    session.conf.set(CHUNK_KEY, chunk)
+    session.conf.set(CACHE_KEY, 0)
+    session.conf.set(SHARD_SPANS_KEY, "on")
+    session.conf.set(MESH_KEY, 8)
+    qe = (session.table(name)
+          .group_by((col("v") % 13).alias("k"))
+          .agg(F.sum(col("v")).alias("s")))._qe()
+    return qe, pdf
+
+
+def test_shard_telemetry_mesh_stream(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    try:
+        qe, pdf = _mesh_stream_qe(session)
+        qe.execute_batch()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+        session.conf.set(MESH_KEY, 0)
+    comp = [r for r in qe.spans.shard_records if r["phase"] == "compute"]
+    assert {r["shard"] for r in comp} == set(range(8))
+    assert max(r["chunk"] for r in comp) >= 2  # genuinely chunked
+    # per-shard row counts tile the scan exactly (psum-free coverage)
+    assert sum(r["rows"] for r in comp) == len(pdf)
+    assert all(r["bytes"] == r["rows"] * 8 for r in comp)
+    ingest = [r for r in qe.spans.shard_records
+              if r["phase"] == "ingest"]
+    assert ingest and all(r["shard"] is None for r in ingest)
+    # exchange transfer vectors rode the metrics channel into records
+    transfer = [r for r in qe.spans.shard_records
+                if r["phase"] == "transfer"]
+    assert transfer and all(
+        r["source"].startswith("exchange:") for r in transfer)
+    # ...and the [n]-vector metrics never leak into scalar last_metrics
+    assert not any(k.startswith("shard_") for k in qe.last_metrics)
+    # event log: schema v3 `shards` replayed by the history views
+    events = history.read_event_log(log_dir)
+    assert events.iloc[-1]["schema_version"] == 3
+    ss = history.shard_summary(events)
+    assert len(ss) == len(qe.spans.shard_records)
+    rep = history.straggler_report(events)
+    assert not rep.empty and not rep["flagged"].any()
+
+
+def test_straggler_monitor_flags_slow_shard(session):
+    """Chaos: a `slow` fault on exactly one shard's telemetry window
+    (shard 5, every chunk) must flag exactly that shard — on_straggler
+    event + straggler_flagged counter — with result parity."""
+    from spark_tpu.observability import QueryListener, StragglerMonitor
+
+    straggler_events = []
+
+    class Sub(QueryListener):
+        def on_straggler(self, e):
+            straggler_events.append(e)
+
+    sub = Sub()
+    session.add_listener(sub)
+    session.conf.set("spark_tpu.sql.straggler.minChunks", 3)
+    session.conf.set("spark_tpu.sql.straggler.factor", 4.0)
+    flagged_before = session.metrics.counter("straggler_flagged").value
+    # 5 chunks x 8 shards; shard 5's window is hit c*8 + 5 + 1
+    rules = ",".join(f"shard_chunk:slow:{c * 8 + 6}:60" for c in range(5))
+    try:
+        with faults.inject(session.conf, rules) as fp:
+            qe, pdf = _mesh_stream_qe(session, name="straggler_t")
+            batch, _, _ = qe.execute_batch()
+            got = batch.to_arrow().to_pandas()
+    finally:
+        session.remove_listener(sub)
+        session.conf.set(MESH_KEY, 0)
+    assert fp.fired_log, "shard_chunk seam never fired — test is vacuous"
+    # parity: the slow shard perturbed nothing but its wait
+    want = pdf.assign(k=pdf.v % 13).groupby("k")["v"].sum()
+    res = got.set_index("k")["s"].sort_index()
+    assert (res == want).all()
+    mon = StragglerMonitor.of(session)
+    assert mon is not None
+    assert mon.report().get(qe.query_id) == {5}, mon.report()
+    assert session.metrics.counter("straggler_flagged").value \
+        == flagged_before + 1
+    assert len(straggler_events) == 1
+    ev = straggler_events[0]
+    assert ev.shard == 5 and ev.query_id == qe.query_id
+    assert ev.median_ms > ev.baseline_ms
+
+
+def test_straggler_monitor_state_self_bounded(session):
+    """With shardSpans=on and NO observability output, on_query_end
+    never fires — the monitor's live maps must self-bound instead of
+    leaking one entry per mesh query (code-review finding)."""
+    from spark_tpu.observability import StragglerMonitor
+    from spark_tpu.observability.listener import ShardChunkEvent
+    from spark_tpu.observability import straggler as S
+    mon = StragglerMonitor.of(session)
+    assert mon is not None
+    for qid in range(1000, 1000 + S._LIVE_BOUND + 5):
+        mon.on_shard_records(ShardChunkEvent(
+            query_id=qid, ts=0.0, chunk=0,
+            records=[{"shard": 0, "host": 0, "phase": "compute",
+                      "wait_ms": 0.1},
+                     {"shard": 1, "host": 0, "phase": "compute",
+                      "wait_ms": 0.1}]))
+    assert len(mon._waits) <= S._LIVE_BOUND
+    assert 1000 not in mon._waits  # oldest evicted
+    assert 1000 + S._LIVE_BOUND + 4 in mon._waits  # newest retained
+
+
+def test_shard_telemetry_retry_discards_failed_attempt(session):
+    """A ChunkRetrier replay re-dispatches the SAME chunk index: the
+    failed attempt's buffered array must be discarded, not flushed —
+    duplicate (shard, chunk) records would double-count row totals
+    and skew straggler medians (code-review finding)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from spark_tpu.observability.spans import (ShardStreamTelemetry,
+                                               SpanRecorder)
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    rec = SpanRecorder(1)
+    telem = ShardStreamTelemetry(rec, mesh, query_id=1)
+    # sharded like the driver's real output: one piece per mesh device
+    arr = jax.device_put(jnp.ones((8,), jnp.int64),
+                         NamedSharding(mesh, PartitionSpec("data")))
+    telem.chunk_dispatched(0, arr, 8, _t.perf_counter())
+    telem.chunk_dispatched(0, arr, 8, _t.perf_counter())  # retry, same ci
+    telem.chunk_dispatched(1, arr, 8, _t.perf_counter())
+    telem.finish()
+    comp = [r for r in rec.shard_records if r["phase"] == "compute"]
+    assert len(comp) == 16  # 2 chunks x 8 shards: retry deduped
+    per_chunk = {(r["chunk"], r["shard"]) for r in comp}
+    assert len(per_chunk) == len(comp)  # no duplicate (chunk, shard)
+
+
+def test_straggler_min_chunks_above_window_still_detects(session):
+    """minChunks above the default rolling WINDOW must widen the
+    window, not silently disable detection (code-review finding)."""
+    from spark_tpu.observability import StragglerMonitor
+    from spark_tpu.observability import straggler as S
+    from spark_tpu.observability.listener import ShardChunkEvent
+    mon = StragglerMonitor.of(session)
+    min_chunks = S.WINDOW + 8
+    session.conf.set("spark_tpu.sql.straggler.minChunks", min_chunks)
+    session.conf.set("spark_tpu.sql.straggler.factor", 3.0)
+    qid = 7777
+    for c in range(min_chunks + 2):
+        mon.on_shard_records(ShardChunkEvent(
+            query_id=qid, ts=0.0, chunk=c,
+            records=[{"shard": s, "host": 0, "phase": "compute",
+                      "wait_ms": 50.0 if s == 2 else 0.1}
+                     for s in range(4)]))
+    assert mon.flagged(qid) == {2}, mon.flagged(qid)
+
+
+def test_shard_telemetry_off_by_default(session):
+    """No observability output + shardSpans=auto: the mesh stream must
+    record nothing (zero flight-recorder tax on bare runs)."""
+    pdf = pd.DataFrame({"v": np.arange(4000, dtype=np.int64)})
+    session.register_table("shard_off_t", pdf)
+    session.conf.set(CHUNK_KEY, 1024)
+    session.conf.set(CACHE_KEY, 0)
+    session.conf.set(MESH_KEY, 8)
+    try:
+        qe = (session.table("shard_off_t")
+              .group_by((col("v") % 7).alias("k"))
+              .agg(F.sum(col("v")).alias("s")))._qe()
+        qe.execute_batch()
+    finally:
+        session.conf.set(MESH_KEY, 0)
+    assert qe.spans.shard_records == []
+
+
+def test_shard_records_bounded(session):
+    session.conf.set(
+        "spark_tpu.sql.observability.maxShardRecords", 10)
+    try:
+        qe, _ = _mesh_stream_qe(session, name="shard_bound_t")
+        qe.execute_batch()
+    finally:
+        session.conf.set(MESH_KEY, 0)
+    assert len(qe.spans.shard_records) == 10
+    assert qe.spans.shard_dropped > 0  # truncation counted, not silent
+
+
+# -- analyzer self-grading (predictions) -------------------------------------
+
+def test_prediction_report_and_grading(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    try:
+        left = pd.DataFrame({"k": np.arange(200, dtype=np.int64) % 50,
+                             "v": np.arange(200, dtype=np.int64)})
+        right = pd.DataFrame({"k": np.arange(50, dtype=np.int64),
+                              "w": np.arange(50, dtype=np.int64)})
+        session.register_table("pred_l", left)
+        session.register_table("pred_r", right)
+        qe = (session.table("pred_l")
+              .join(session.table("pred_r"), on="k")
+              .group_by(col("k")).agg(F.sum(col("v")).alias("s")))._qe()
+        qe.execute_batch()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    assert qe.plan_predictions, "no predictions harvested from the plan"
+    kinds = {p["kind"] for p in qe.plan_predictions}
+    assert "join_rows" in kinds and "agg_groups" in kinds
+    graded = history.grade_predictions(qe.plan_predictions,
+                                       qe.last_metrics)
+    assert graded, (qe.plan_predictions, qe.last_metrics)
+    assert all(g["grade"] in ("hit", "over", "under") for g in graded)
+    jr = [g for g in graded if g["kind"] == "join_rows"]
+    assert jr and jr[0]["observed"] == 200  # fk join: one match per row
+    # replayed from the event log, the report grades the same rows
+    events = history.read_event_log(log_dir)
+    rep = history.prediction_report(events)
+    assert len(rep) >= len(graded)
+    assert set(rep["grade"]) <= {"hit", "over", "under"}
+
+
+# -- events_tool (schema validation + tail) ----------------------------------
+
+def _events_tool():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "events_tool", os.path.join(root, "scripts", "events_tool.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_events_tool_validate_and_tail(session, tmp_path):
+    log_dir = str(tmp_path / "ev")
+    session.conf.set(EVENT_KEY, log_dir)
+    try:
+        _fresh_agg(session, 784).to_pandas()
+    finally:
+        session.conf.set(EVENT_KEY, "")
+    tool = _events_tool()
+    assert tool.validate([log_dir]) == []
+    assert tool.main(["validate", log_dir]) == 0
+    lines = tool.tail([log_dir], n=5)
+    assert lines and "ok" in lines[-1]
+    # a corrupt line and a schema violation both fail loudly
+    path = os.path.join(log_dir, os.listdir(log_dir)[0])
+    with open(path, "a") as f:
+        f.write("not json\n")
+        f.write(json.dumps({"schema_version": 2, "query_id": 1,
+                            "ts": 1.0, "status": "ok", "plan": "p",
+                            "shards": []}) + "\n")  # v3 field in v2
+    problems = tool.validate([log_dir])
+    assert len(problems) == 2, problems
+    assert tool.main(["validate", log_dir]) == 1
+    # old-version lines (v2, no shards) still validate
+    ok2 = {"schema_version": 2, "query_id": 1, "ts": 1.0,
+           "status": "ok", "plan": "p",
+           "phase_times_s": {"execution": 0.1}}
+    p2 = tmp_path / "old" / "app-1-old.jsonl"
+    p2.parent.mkdir()
+    p2.write_text(json.dumps(ok2) + "\n")
+    assert tool.validate([str(tmp_path / "old")]) == []
 
 
 # -- golden parity with everything on ----------------------------------------

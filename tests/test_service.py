@@ -445,6 +445,130 @@ def test_http_admission_rejection_structured(service, tpch_path):
     assert status == 200 and resp["status"] == "ok"
 
 
+def test_http_query_listing_timeline_and_plan(service, tpch_path):
+    """The live history API: GET /queries lists a completed Q1,
+    /queries/<id>/timeline serves phase spans + stage peak-HBM +
+    per-shard rows as JSON, /queries/<id>/plan serves the runtime
+    tree — no JSONL scraping."""
+    svc = service(**{"spark_tpu.sql.observability.xlaCost": "on"})
+    svc.start()
+    port = svc.port
+    _, resp = _post_sql(port, {"sql": SQLQ.Q1})
+    qid = resp["query_id"]
+    _post_sql(port, {"sql": "select count(*) as n from lineitem"})
+    status, listing = _get_json(port, "/queries")
+    assert status == 200 and listing["total"] >= 2
+    assert listing["queries"][0]["submitted_ts"] >= \
+        listing["queries"][-1]["submitted_ts"]  # newest first
+    assert any(q["id"] == qid and q["status"] == "ok"
+               for q in listing["queries"])
+    # pagination: limit=1 pages with next_offset
+    _, page = _get_json(port, "/queries?limit=1")
+    assert len(page["queries"]) == 1 and page["next_offset"] == 1
+    _, page2 = _get_json(port, "/queries?limit=1&offset=1")
+    assert page2["queries"][0]["id"] != page["queries"][0]["id"]
+    # filters
+    _, only_ok = _get_json(port, "/queries?status=ok&session=default")
+    assert only_ok["total"] >= 2
+    # timeline: spans + stage HBM + shards list (empty on single chip)
+    _, tl = _get_json(port, f"/queries/{qid}/timeline")
+    assert tl["engine_query_id"] >= 1
+    assert any(s["name"] == "dispatch" for s in tl["spans"]), tl["spans"]
+    assert any(s.get("peak_hbm_bytes") for s in tl["stages"]), tl
+    assert isinstance(tl["shards"], list)
+    assert tl["phase_times_s"].get("execution") is not None
+    # plan: runtime-annotated physical tree + the submitted SQL
+    _, pl = _get_json(port, f"/queries/{qid}/plan")
+    assert "HashAggregateExec" in pl["physical"], pl
+    assert "rows out" in pl["physical"]  # runtime annotations present
+    assert pl["sql"].lstrip().lower().startswith("select")
+    # unknown ids 404 on both detail endpoints
+    for suffix in ("timeline", "plan"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_json(port, f"/queries/q-99999/{suffix}")
+        assert exc.value.code == 404
+
+
+def test_history_store_bounded(service):
+    from spark_tpu.service.query_history import QueryHistoryStore
+    store = QueryHistoryStore(max_entries=2)
+    for i in range(4):
+        store.put(f"q-{i}", {"engine_query_id": i})
+    assert len(store) == 2
+    assert store.get("q-0") is None and store.get("q-3") is not None
+
+
+def test_concurrent_queries_scrape_and_rotation(service, tpch_path,
+                                                tmp_path):
+    """Satellite: pooled sessions running parallel queries while
+    /metrics is scraped and the event log rotates (tiny maxBytes) —
+    the Prometheus text must stay parseable on every scrape and the
+    rotated event log must replay with zero corrupt lines."""
+    from spark_tpu.service.query_history import QueryHistoryStore  # noqa: F401
+    ev_dir = str(tmp_path / "ev")
+    svc = service(**{
+        "spark_tpu.sql.eventLog.dir": ev_dir,
+        "spark_tpu.sql.eventLog.maxBytes": 512,
+        "spark_tpu.sql.metrics.sink": "prometheus",
+        "spark_tpu.sql.metrics.dir": str(tmp_path / "m"),
+    }).start()
+    port = svc.port
+    n_sessions, n_rounds = 3, 3
+    errors = []
+    done = threading.Event()
+
+    def run(sess):
+        try:
+            for _ in range(n_rounds):
+                record, _ = svc.submit(
+                    "select count(*) as n from lineitem", session=sess)
+                assert record["status"] == "ok"
+        except Exception as e:  # noqa: BLE001 — surfaced via errors
+            errors.append((sess, e))
+
+    def scrape():
+        try:
+            while not done.is_set():
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=30) as m:
+                    parsed = parse_prometheus_text(m.read().decode())
+                assert isinstance(parsed, dict)
+                time.sleep(0.01)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("scrape", e))
+
+    threads = [threading.Thread(target=run, args=(f"s{i}",))
+               for i in range(n_sessions)]
+    scraper = threading.Thread(target=scrape)
+    scraper.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    done.set()
+    scraper.join(60)
+    assert not errors, errors
+    # rotated log replays completely: one parseable line per query,
+    # schema-valid throughout (read_event_log raises on corrupt JSON)
+    import os as _os
+    from spark_tpu import history as H
+    files = _os.listdir(ev_dir)
+    assert len(files) > n_sessions, files  # rotation actually rolled
+    events = H.read_event_log(ev_dir)
+    assert len(events) == n_sessions * n_rounds
+    assert (events["status"] == "ok").all()
+    assert (events["schema_version"] == 3).all()
+    # the versioned-schema validator agrees line by line
+    import importlib.util
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "events_tool", _os.path.join(root, "scripts", "events_tool.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    assert tool.validate([ev_dir]) == []
+
+
 def test_http_async_submission(service):
     svc = service().start()
     status, resp = _post_sql(svc.port, {"sql": SQLQ.Q1, "mode": "async"})
