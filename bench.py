@@ -55,6 +55,9 @@ N_100G = 1_000_000_000
 TPCH_SF = float(os.environ.get("BENCH_TPCH_SF", "1"))
 TPCH_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "data", "tpch", f"sf{TPCH_SF:g}")
+TPCDS_SF = float(os.environ.get("BENCH_TPCDS_SF", "1"))
+TPCDS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "data", "tpcds", f"sf{TPCDS_SF:g}")
 
 
 class SectionTimeout(BaseException):
@@ -124,6 +127,38 @@ def _time3(run_sync):
         run_sync()
         times.append(time.perf_counter() - t0)
     return min(times)
+
+
+def _warm_best2(run_once):
+    """Warmup + best-of-2 for the TPC query sections: `run_once`
+    returns (qe, result); returns (qe, result, best_seconds). ONE
+    definition so the tpch and tpcds sections cannot drift on the
+    warmup protocol."""
+    run_once()  # warmup: compile + first ingest
+    best = None
+    qe = got = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        qe, got = run_once()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return qe, got, best
+
+
+def _prediction_sidecars(qe, extra: dict, key: str) -> None:
+    """Analyzer/planner self-grading sidecars (mean |error| of the
+    plan-time size predictions vs this run's observed metrics) under
+    `<key>_pred_err_pct` / `<key>_pred_under` — shared by the tpch and
+    tpcds sections so the grading semantics cannot drift."""
+    from spark_tpu.history import grade_predictions
+    graded = grade_predictions(qe.plan_predictions or [],
+                               qe.last_metrics)
+    errs = [abs(g["err_pct"]) for g in graded
+            if g.get("err_pct") is not None]
+    if errs:
+        extra[f"{key}_pred_err_pct"] = round(sum(errs) / len(errs), 1)
+        extra[f"{key}_pred_under"] = sum(
+            1 for g in graded if g["grade"] == "under")
 
 
 def bench_linear_keys(spark):
@@ -369,14 +404,8 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
         # ingest-pipeline sidecar baselines (registry counters)
         stall0 = spark.metrics.counter("ingest_stall_ms").value
         overlap0 = spark.metrics.counter("ingest_overlap_ms").value
-        _, got = run_once()  # warmup (compile + first ingest)
-        times = []
-        qe = None
-        for _ in range(2):
-            t0 = time.perf_counter()
-            qe, got = run_once()
-            times.append(time.perf_counter() - t0)
-        extra[f"tpch_{name}_sf{sf:g}_ms"] = round(min(times) * 1e3, 1)
+        qe, got, best = _warm_best2(run_once)
+        extra[f"tpch_{name}_sf{sf:g}_ms"] = round(best * 1e3, 1)
         # ingest vs compute split of the last run (VERDICT r3 next-1d):
         # with the device-table cache warm, ingest should be ~0
         for phase in ("ingest", "execution", "streaming"):
@@ -416,21 +445,10 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
             - overlap0, 3)
         extra[f"tpch_{name}_sf{sf:g}_ingest_stall_ms"] = round(
             spark.metrics.counter("ingest_stall_ms").value - stall0, 3)
-        # analyzer self-grading sidecar: mean |error| of the plan-time
-        # size predictions (exchange rows/bytes, join caps, aggregate
-        # group estimates) vs this run's observed metrics — the BENCH
-        # trajectory shows whether the estimators feeding AQE seeds
-        # and runtime-filter sizing are getting tighter or drifting
-        from spark_tpu.history import grade_predictions
-        graded = grade_predictions(qe.plan_predictions or [],
-                                   qe.last_metrics)
-        errs = [abs(g["err_pct"]) for g in graded
-                if g.get("err_pct") is not None]
-        if errs:
-            extra[f"tpch_{name}_sf{sf:g}_pred_err_pct"] = round(
-                sum(errs) / len(errs), 1)
-            misses = sum(1 for g in graded if g["grade"] == "under")
-            extra[f"tpch_{name}_sf{sf:g}_pred_under"] = int(misses)
+        # analyzer self-grading sidecar: the BENCH trajectory shows
+        # whether the estimators feeding AQE seeds and runtime-filter
+        # sizing are getting tighter or drifting
+        _prediction_sidecars(qe, extra, f"tpch_{name}_sf{sf:g}")
         # static-analyzer sidecar: findings per query (the BENCH
         # trajectory must show analyzer noise staying at zero on the
         # TPC-H suite; a nonzero count is either a real hazard at this
@@ -455,6 +473,58 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
         G.compare(got.reset_index(drop=True), want,
                   float_rtol=1e-6, float_atol=float_atol)
         extra[f"tpch_{name}_parity"] = True
+    return extra
+
+
+def bench_tpcds(spark, sf: float, path: str,
+                queries=("q3", "q19", "q68"), float_atol: float = 1e-3,
+                deadline: float = None):
+    """TPC-DS tranche section: generate (cached) SF data, run the
+    representative snowflake queries timed with result parity against
+    the independent pandas goldens, and emit the `tpcds_*_ms` rows the
+    perf gate tracks plus the prediction-error and join-reorder
+    sidecars — the reference's committed perf baselines are TPC-DS
+    (`TPCDSQueryBenchmark.scala:54`), so the BENCH trajectory now has
+    the same spine."""
+    from spark_tpu.tpcds import SQL_QUERIES, register_tables
+    from spark_tpu.tpcds import golden as G
+    from spark_tpu.tpcds.datagen import write_parquet
+
+    write_parquet(path, sf)
+    register_tables(spark, path)
+    extra = {}
+    for name in queries:
+        if deadline is not None and time.perf_counter() > deadline:
+            extra[f"tpcds_{name}_sf{sf:g}_skipped"] = "time budget"
+            continue
+
+        def run_once():
+            qe = spark.sql(SQL_QUERIES[name])._qe()
+            b, _, _ = qe.execute_batch()
+            return qe, b.to_arrow().to_pandas()
+
+        qe, got, best = _warm_best2(run_once)
+        extra[f"tpcds_{name}_sf{sf:g}_ms"] = round(best * 1e3, 1)
+        for phase in ("ingest", "execution", "streaming"):
+            if phase in qe.phase_times:
+                extra[f"tpcds_{name}_{phase}_ms"] = round(
+                    qe.phase_times[phase] * 1e3, 1)
+        # self-grading sidecars (incl. basis cbo-reorder predictions),
+        # plus whether the reorder pass changed this query's join
+        # SEQUENCE (kind "order" — an orientation-only flip must not
+        # read as a reorder, same discipline as tests/preflight)
+        _prediction_sidecars(qe, extra, f"tpcds_{name}_sf{sf:g}")
+        extra[f"tpcds_{name}_sf{sf:g}_reordered"] = int(any(
+            d.get("kind") == "order"
+            for d in (qe.reorder_decisions or [])))
+        extra[f"tpcds_{name}_sf{sf:g}_analysis_findings"] = int(
+            len(qe.analysis_findings or []))
+        # result parity vs the independent pandas implementation
+        got = G.normalize_decimals(got)
+        want = G.GOLDEN[name](path)
+        G.compare(got[list(want.columns)].reset_index(drop=True), want,
+                  float_rtol=1e-6, float_atol=float_atol)
+        extra[f"tpcds_{name}_parity"] = True
     return extra
 
 
@@ -616,6 +686,16 @@ def main():
             deadline=time.perf_counter()
             + min(tpch_budget, max(remaining(), 1)) * 0.9),
         tpch_budget))
+    emit_summary()
+    # TPC-DS tranche: the reference's own committed-baseline suite (3
+    # representative snowflake queries under the same budget machinery)
+    extra.update(run_budgeted(
+        f"tpcds_sf{TPCDS_SF:g}",
+        lambda: bench_tpcds(
+            spark, TPCDS_SF, TPCDS_PATH,
+            deadline=time.perf_counter()
+            + min(budget, max(remaining(), 1)) * 0.9),
+        budget))
     emit_summary()
 
     # SF10: the north-star scale on one chip (VERDICT r4 #2). The

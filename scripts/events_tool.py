@@ -87,12 +87,23 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
                      f"malformed stage-cost record: {st!r}")
             break
     if ver < 3:
-        for v3_field in ("shards", "predictions", "plan_tree"):
+        for v3_field in ("shards", "predictions", "plan_tree",
+                         "reorder"):
             if v3_field in e:
                 _problem(out, path, lineno,
                          f"schema v{ver} record carries v3 field "
                          f"{v3_field!r}")
         return
+    reorder = e.get("reorder")
+    if reorder is not None and (
+            not isinstance(reorder, dict)
+            or not isinstance(reorder.get("regions"), list)
+            or any(not isinstance(d, dict)
+                   or not isinstance(d.get("relations"), list)
+                   or not isinstance(d.get("order"), list)
+                   for d in reorder["regions"])):
+        _problem(out, path, lineno,
+                 f"malformed reorder record: {reorder!r}")
     for rec in e.get("shards") or []:
         bad = None
         if not isinstance(rec, dict):

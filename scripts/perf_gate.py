@@ -38,30 +38,56 @@ sys.path.insert(0, REPO)
 
 
 def last_good_bench() -> tuple:
-    """(name, {metric: ms}) of the newest BENCH_*.json whose parsed
-    summary carries tpch_*_ms metrics; (None, {}) when the trajectory
-    is dark."""
+    """(name, {metric: ms}) merged PER FAMILY from the newest
+    BENCH_*.json rounds: tpch_*_ms from the newest round that carries
+    any, tpcds_*_ms likewise — a round whose tpch section timed out
+    but whose tpcds section parsed must not shadow an older round's
+    good tpch numbers (and vice versa). `name` is the newest
+    contributing round; (None, {}) when the trajectory is dark."""
     rounds = []
     for name in os.listdir(REPO):
         m = re.match(r"BENCH_r(\d+)\.json$", name)
         if m:
             rounds.append((int(m.group(1)), name))
+    merged: dict = {}
+    newest = None
+    seen_families = set()
     for _, name in sorted(rounds, reverse=True):
         try:
             doc = json.load(open(os.path.join(REPO, name)))
         except (OSError, ValueError):
             continue
         extra = ((doc.get("parsed") or {}).get("extra")) or {}
-        ms = {k: float(v) for k, v in extra.items()
-              if re.match(r"tpch_q\d+_sf[\d.]+_ms$", k)}
-        if ms:
-            return name, ms
-    return None, {}
+        for fam in ("tpch", "tpcds"):
+            if fam in seen_families:
+                continue
+            ms = {k: float(v) for k, v in extra.items()
+                  if re.match(fam + r"_q\d+_sf[\d.]+_ms$", k)}
+            if ms:
+                seen_families.add(fam)
+                merged.update(ms)
+                if newest is None:
+                    newest = name
+        if len(seen_families) == 2:
+            break
+    return newest, merged
 
 
-def measure(sf: float, queries) -> dict:
+def _time3(run_once) -> float:
+    run_once()  # warmup: compile + ingest
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_once()
+        times.append(time.perf_counter() - t0)
+    return round(min(times) * 1e3, 1)
+
+
+def measure(sf: float, queries, tpcds_queries=()) -> dict:
     """Warm min-of-3 wall-clock per query at `sf` on the current
-    backend — the same shape bench.py's tpch section times."""
+    backend — the same shapes bench.py's tpch/tpcds sections time.
+    `queries` are TPC-H DataFrame names (tpch_<q>_ms keys);
+    `tpcds_queries` are TPC-DS SQL names (tpcds_<q>_ms keys)."""
     import tempfile
 
     from spark_tpu import SparkTpuSession
@@ -82,13 +108,23 @@ def measure(sf: float, queries) -> dict:
             b, _, _ = qe.execute_batch()
             return b.to_arrow()
 
-        run_once()  # warmup: compile + ingest
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            run_once()
-            times.append(time.perf_counter() - t0)
-        out[f"tpch_{name}_ms"] = round(min(times) * 1e3, 1)
+        out[f"tpch_{name}_ms"] = _time3(run_once)
+    if tpcds_queries:
+        from spark_tpu.tpcds import SQL_QUERIES, register_tables
+        from spark_tpu.tpcds.datagen import write_parquet as ds_write
+        ds_path = os.path.join(tempfile.gettempdir(),
+                               f"perf_gate_tpcds_sf{sf:g}")
+        ds_write(ds_path, sf)
+        register_tables(spark, ds_path)
+        for name in tpcds_queries:
+            sql = SQL_QUERIES[name]
+
+            def run_once_ds():
+                qe = spark.sql(sql)._qe()
+                b, _, _ = qe.execute_batch()
+                return b.to_arrow()
+
+            out[f"tpcds_{name}_ms"] = _time3(run_once_ds)
     return out
 
 
@@ -116,8 +152,8 @@ def _default_sf(bench_ms: dict) -> float:
     if jax.default_backend() != "tpu" or not bench_ms:
         return 0.01
     sfs = [float(m.group(1)) for m in
-           (re.match(r"tpch_q\d+_sf([\d.]+)_ms$", k) for k in bench_ms)
-           if m]
+           (re.match(r"tpc(?:h|ds)_q\d+_sf([\d.]+)_ms$", k)
+            for k in bench_ms) if m]
     return max(sfs) if sfs else 0.01
 
 
@@ -126,12 +162,14 @@ def main(argv) -> int:
     floor_ms = float(os.environ.get("PERF_GATE_FLOOR_MS", "200"))
     queries = [q.strip() for q in os.environ.get(
         "PERF_GATE_QUERIES", "q1,q3").split(",") if q.strip()]
+    tpcds_queries = [q.strip() for q in os.environ.get(
+        "PERF_GATE_TPCDS_QUERIES", "q3,q19").split(",") if q.strip()]
     update = "--update" in argv
 
     bench_name, bench_ms = last_good_bench()
     sf_env = os.environ.get("PERF_GATE_SF")
     sf = float(sf_env) if sf_env else _default_sf(bench_ms)
-    current = measure(sf, queries)
+    current = measure(sf, queries, tpcds_queries)
     key = platform_key(sf)
 
     baselines = {}
@@ -147,12 +185,19 @@ def main(argv) -> int:
         # are same-platform/same-scale (the TPU driver path), else the
         # current measurement (the CPU preflight path)
         seeded = {}
-        for name in queries:
-            bkey = f"tpch_{name}_sf{sf:g}_ms"
-            if platform_key(sf).startswith("tpu") and bkey in bench_ms:
-                seeded[f"tpch_{name}_ms"] = bench_ms[bkey]
+        if key.startswith("tpu"):  # key is platform_key(sf), computed once
+            for fam, names in (("tpch", queries),
+                               ("tpcds", tpcds_queries)):
+                for name in names:
+                    bkey = f"{fam}_{name}_sf{sf:g}_ms"
+                    if bkey in bench_ms:
+                        seeded[f"{fam}_{name}_ms"] = bench_ms[bkey]
         source = bench_name if seeded else "self"
-        entry = dict(seeded or current, calibrated_against=source,
+        # per-family merge: bench-seeded keys win, the current
+        # measurement fills every family the bench round didn't carry
+        # (a partial seed must not leave the other family ungated)
+        entry = dict(current, **seeded)
+        entry.update(calibrated_against=source,
                      calibrated_ts=round(time.time(), 1))
         baselines[key] = entry
         with open(BASELINE_PATH, "w") as f:
@@ -161,6 +206,19 @@ def main(argv) -> int:
         print(json.dumps({"perf_gate": "calibrated", "platform": key,
                           "source": source, "current": current}))
         return 0
+
+    # metrics measured for the first time on an existing baseline (the
+    # tpcds family landing on a platform calibrated pre-tranche):
+    # self-calibrate JUST the missing keys so the next run gates them
+    missing = {k: v for k, v in current.items() if k not in entry}
+    if missing:
+        entry.update(missing)
+        baselines[key] = entry
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baselines, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({"perf_gate": "extended", "platform": key,
+                          "new_metrics": missing}))
 
     failures = []
     for metric, now in sorted(current.items()):
@@ -174,7 +232,7 @@ def main(argv) -> int:
     verdict = {"perf_gate": "fail" if failures else "ok",
                "platform": key, "current": current,
                "baseline": {k: v for k, v in entry.items()
-                            if k.startswith("tpch_")},
+                            if k.startswith(("tpch_", "tpcds_"))},
                "last_good_bench": bench_name}
     if failures:
         verdict["regressions"] = failures

@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Eight stages, all mandatory:
+# Nine stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -46,9 +46,13 @@
 #      across join.kernelMode hash vs sort (the hash path PROVEN to
 #      have run via join_table_slots_*) and ingest.prefetch on vs off,
 #      plus a reduced-size join_microbench section run
+#   9. TPC-DS smoke: SF0.01 datagen + two tranche queries (q3 + the
+#      6-way q19) at pandas golden parity, and the cost-based join
+#      reorder proven live — cbo.joinReorder on/off byte-identical
+#      with the reorder decisions actually changing q19's join order
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-8 still run) for quick
+#   --fast skips the full pytest suite (stages 2-9 still run) for quick
 #   inner-loop checks; CI and end-of-round runs must use the default.
 
 set -euo pipefail
@@ -60,7 +64,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/8: tier-1 test suite --"
+    echo "-- stage 1/9: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -74,16 +78,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/8: SKIPPED (--fast) --"
+    echo "-- stage 1/9: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/8: dryrun_multichip(8) --"
+echo "-- stage 2/9: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/8: bench smoke --"
+echo "-- stage 3/9: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -115,7 +119,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/8: chaos smoke --"
+echo "-- stage 4/9: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -169,7 +173,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/8: observability + analysis smoke --"
+echo "-- stage 5/9: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -262,10 +266,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/8: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/9: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/8: SQL service smoke --"
+echo "-- stage 7/9: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -339,7 +343,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/8: join-kernel + ingest parity smoke --"
+echo "-- stage 8/9: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -396,5 +400,49 @@ assert any(k.endswith("_hash_rows_per_sec_M") for k in mb), mb
 print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
+
+echo "-- stage 9/9: TPC-DS + join-reorder smoke --"
+# SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
+# reorder proven live: on/off byte-identical with q19's join order
+# demonstrably changed (decision log + differing physical plans).
+env JAX_PLATFORMS=cpu python - <<'EOF5'
+import json
+import tempfile
+
+import pandas as pd
+
+from spark_tpu import SparkTpuSession
+from spark_tpu.tpcds import SQL_QUERIES, register_tables
+from spark_tpu.tpcds import golden as G
+from spark_tpu.tpcds.datagen import write_parquet
+
+spark = SparkTpuSession.builder().get_or_create()
+path = tempfile.mkdtemp(prefix="preflight_tpcds_") + "/sf"
+write_parquet(path, 0.01)
+register_tables(spark, path)
+
+CBO = "spark_tpu.sql.cbo.joinReorder"
+reordered = 0
+for qname in ("q3", "q19"):
+    spark.conf.set(CBO, True)
+    qe_on = spark.sql(SQL_QUERIES[qname])._qe()
+    on = qe_on.collect().to_pandas()
+    spark.conf.set(CBO, False)
+    qe_off = spark.sql(SQL_QUERIES[qname])._qe()
+    off = qe_off.collect().to_pandas()
+    spark.conf.set(CBO, True)
+    pd.testing.assert_frame_equal(on, off)
+    if any(d.get("kind") == "order"
+           for d in (qe_on.reorder_decisions or [])):
+        reordered += 1
+        assert qe_on.executed_plan.describe() != \
+            qe_off.executed_plan.describe(), qname
+    want = G.GOLDEN[qname](path)
+    got = G.normalize_decimals(on.copy())[list(want.columns)]
+    G.compare(got.reset_index(drop=True), want, float_atol=1e-4)
+assert reordered >= 1, "join reorder never changed an order (vacuous)"
+print(json.dumps({"preflight_tpcds_smoke": "ok",
+                  "reordered_queries": reordered}))
+EOF5
 
 echo "== preflight PASSED =="

@@ -86,11 +86,98 @@ def _value_bits(expr, schema) -> Optional[int]:
     return None
 
 
-def _check_agg_overflow(node: P.HashAggregateExec, out: List[Finding]
-                        ) -> None:
+def _find_transparent_scan(node: P.PhysicalPlan, name: str
+                           ) -> Optional[P.ScanExec]:
+    """The ScanExec that produces column `name` UNCHANGED below
+    `node`, or None. Same discipline as the runtime-filter descent's
+    `_keys_transparent`: name resolution alone is not enough — a
+    Project aliasing a different expression onto the name, an
+    ambiguous join, or an aggregate computing it means the scan's
+    footer bounds do not bound the column's values here."""
+    from ..expr import Alias, ColumnRef
+    if isinstance(node, P.ScanExec):
+        try:
+            names = node.schema().names
+        except Exception:  # noqa: BLE001
+            return None
+        return node if name in names else None
+    if isinstance(node, (P.FilterExec, P.ExchangeExec, P.SortExec,
+                         P.LimitExec, P.RuntimeFilterExec)):
+        return _find_transparent_scan(node.children[0], name)
+    if isinstance(node, P.ProjectExec):
+        for e in node.exprs:
+            if e.name() != name:
+                continue
+            base = e
+            while isinstance(base, Alias):
+                base = base.child
+            if isinstance(base, ColumnRef) and base.name() == name:
+                return _find_transparent_scan(node.children[0], name)
+            return None
+        return None
+    if isinstance(node, P.JoinExec):
+        try:
+            in_left = name in node.left.schema().names
+            in_right = name in node.right.schema().names
+        except Exception:  # noqa: BLE001
+            return None
+        if in_left and in_right:
+            return None  # ambiguous origin
+        if in_left:
+            return _find_transparent_scan(node.left, name)
+        if in_right:
+            return _find_transparent_scan(node.right, name)
+    return None
+
+
+def _footer_value_bits(expr, node: P.PhysicalPlan, conf
+                       ) -> Optional[int]:
+    """Magnitude bound from Parquet-footer column statistics: bits b
+    with |values| < 2^b for a plain column reference whose scan-level
+    min/max survived the descent. Tightens (or, for unbounded 64-bit
+    inputs, establishes) the dtype-width bound — the carried ROADMAP
+    lever."""
+    from ..expr import Alias, ColumnRef
+    if conf is None or not bool(conf.get(
+            "spark_tpu.sql.stats.parquetFooter")):
+        return None
+    base = expr
+    while isinstance(base, Alias):
+        base = base.child
+    if not isinstance(base, ColumnRef):
+        return None
+    name = base.name()
+    scan = _find_transparent_scan(node.children[0], name)
+    if scan is None:
+        return None
+    try:
+        stats = (scan.source.column_stats() or {}).get(name)
+        dt = scan.schema().field(name).dtype
+    except Exception:  # noqa: BLE001 — stats are advisory
+        return None
+    if stats is None:
+        return None
+    import decimal
+    mags = []
+    for v in (stats.get("min"), stats.get("max")):
+        if isinstance(v, bool) or not isinstance(
+                v, (int, decimal.Decimal)):
+            return None
+        if isinstance(dt, T.DecimalType):
+            v = int(abs(decimal.Decimal(v)).scaleb(dt.scale))
+        else:
+            v = abs(int(v))
+        mags.append(v)
+    return max(1, int(max(mags)).bit_length())
+
+
+def _check_agg_overflow(node: P.HashAggregateExec, out: List[Finding],
+                        conf=None) -> None:
     """SUM/AVG accumulators are int64 for integral/decimal inputs
     (expr_agg.Sum.accumulators); a bound of rows x 2^value_bits past
-    2^63 means the total can wrap with no error raised anywhere."""
+    2^63 means the total can wrap with no error raised anywhere.
+    Magnitude bounds take the TIGHTEST of the expression/dtype bound
+    and the Parquet-footer min/max bound."""
     from ..expr_agg import Avg, Sum
     if node.mode == "final":
         return  # the partial stage below already carries the bound
@@ -119,6 +206,9 @@ def _check_agg_overflow(node: P.HashAggregateExec, out: List[Finding]
         if not isinstance(dt, (T.IntegralType, T.DecimalType)):
             continue
         bits = _value_bits(f.child, base)
+        footer_bits = _footer_value_bits(f.child, node, conf)
+        if footer_bits is not None:
+            bits = footer_bits if bits is None else min(bits, footer_bits)
         if bits is None:
             continue
         if rows_bits + bits > _ACC_BITS:
@@ -386,7 +476,7 @@ def analyze_plan(root: P.PhysicalPlan, conf,
     so a broken estimator can never fail the query."""
     out: List[Finding] = []
     checks = (
-        lambda: _walk_aggregates(root, out),
+        lambda: _walk_aggregates(root, out, conf),
         lambda: _check_host_sync(root, conf, mesh_n, out),
         lambda: _check_recompile(root, conf, out),
         lambda: _check_hash_join(root, conf, out),
@@ -403,7 +493,8 @@ def analyze_plan(root: P.PhysicalPlan, conf,
     return out
 
 
-def _walk_aggregates(root: P.PhysicalPlan, out: List[Finding]) -> None:
+def _walk_aggregates(root: P.PhysicalPlan, out: List[Finding],
+                     conf=None) -> None:
     seen = set()
 
     def walk(node):
@@ -413,6 +504,6 @@ def _walk_aggregates(root: P.PhysicalPlan, out: List[Finding]) -> None:
         for c in node.children:
             walk(c)
         if isinstance(node, P.HashAggregateExec):
-            _check_agg_overflow(node, out)
+            _check_agg_overflow(node, out, conf)
 
     walk(root)

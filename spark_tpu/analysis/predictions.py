@@ -90,6 +90,14 @@ def _predict_node(node, out: List[dict], mesh_n: int) -> None:
             out.append({"kind": "join_rows", "tag": node.tag,
                         "predicted": int(node.out_cap),
                         "basis": "out_cap"})
+        elif getattr(node, "cbo_est_rows", None) is not None:
+            # the reorder cost model's own output estimate — grading it
+            # against join_rows_<tag> closes the loop on the order
+            # decisions (a systematically-wrong model shows up in
+            # history.prediction_report as basis cbo-reorder misses)
+            out.append({"kind": "join_rows", "tag": node.tag,
+                        "predicted": int(node.cbo_est_rows),
+                        "basis": "cbo-reorder"})
         else:
             rows = _estimate_rows(node.children[0])
             if rows is not None and rows > 0:

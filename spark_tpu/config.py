@@ -395,6 +395,37 @@ RUNTIME_FILTER_FPP = register(
         "positives only reduce pruning, never correctness.",
     validator=lambda v: 0.0 < v < 1.0)
 
+CBO_JOIN_REORDER = register(
+    "spark_tpu.sql.cbo.joinReorder", True,
+    doc="Cost-based join reorder (plan/join_reorder.py, the "
+        "CostBasedJoinReorder.scala analog): re-sequence maximal "
+        "regions of inner equi-joins by estimated cost — source row "
+        "counts x filter selectivities (Parquet-footer min/max "
+        "interpolation for ranges when stats.parquetFooter is on), "
+        "left-deep DP minimizing the sum of intermediate sizes. "
+        "Results are identical on/off (only join order changes); off "
+        "restores the frontend order. Decisions land in the event "
+        "log's `reorder` records and explain(); per-join estimates "
+        "are graded by history.prediction_report (basis cbo-reorder).")
+
+CBO_MAX_RELATIONS = register(
+    "spark_tpu.sql.cbo.maxReorderRelations", 8,
+    doc="Upper bound on relations per reordered join region: the "
+        "left-deep DP enumerates connected subsets (2^n states), so "
+        "larger regions keep the frontend order. The "
+        "spark.sql.cbo.joinReorder.dp.threshold seat.",
+    validator=lambda v: 2 <= v <= 14)
+
+STATS_PARQUET_FOOTER = register(
+    "spark_tpu.sql.stats.parquetFooter", True,
+    doc="Read per-column min/max (and row-group counts) from Parquet "
+        "footers (io/sources.py column_stats), cached per source. "
+        "Consumers: the reorder cost model's range selectivities and "
+        "the analyzer's SUM_I64_OVERFLOW magnitude bounds (a column "
+        "whose footer max is small cannot overflow an int64 "
+        "accumulator at any plausible row count). Reading footers "
+        "touches no row data.")
+
 ADAPTIVE_ENABLED = register(
     "spark_tpu.sql.adaptive.enabled", True,
     doc="Enable the stats->re-jit retry loop for join/exchange/aggregate "

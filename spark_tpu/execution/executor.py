@@ -56,6 +56,9 @@ class QueryExecution:
         # (analysis/predictions.py) — graded against observed metrics
         # by history.prediction_report / grade_predictions
         self.plan_predictions: Optional[list] = None
+        # cost-based join-reorder decisions (plan/join_reorder.py);
+        # None until the optimizer ran for this execution
+        self.reorder_decisions: Optional[list] = None
         # set per execute_batch: False keeps event construction off the
         # hot path when nothing is listening
         self._observe_events = False
@@ -188,7 +191,13 @@ class QueryExecution:
             t0 = time.perf_counter()
             plan = self._apply_cache(self.analyzed)
             plan = self._resolve_scalar_subqueries(plan)
-            self._optimized = default_optimizer().execute(plan)
+            log: list = []
+            self._optimized = default_optimizer(
+                self._conf, reorder_log=log).execute(plan)
+            # cost-based join-reorder decisions (plan/join_reorder.py):
+            # one record per eligible region, into the event log and
+            # the explain()/history API "reorder: yes/no" annotation
+            self.reorder_decisions = log
             t1 = time.perf_counter()
             self.phase_times["optimization"] = t1 - t0
             self.spans.record("optimize", t0, t1)
@@ -231,6 +240,7 @@ class QueryExecution:
         else:
             out += ["== Physical Plan ==",
                     self.executed_plan.tree_string()]
+        out += ["== Join Reorder =="] + self._reorder_lines()
         if analysis:
             out.append("== Static Analysis ==")
             findings = self.analysis_findings
@@ -249,6 +259,28 @@ class QueryExecution:
                 out.append("  no findings")
         return "\n".join(out)
 
+    def _reorder_lines(self) -> List[str]:
+        """Human-readable cost-based join-reorder annotation for
+        explain(): 'reorder: yes/no' plus, per region, the frontend
+        order, the chosen order, and the per-join estimated rows."""
+        self.executed_plan  # ensure the optimizer (and its log) ran
+        decisions = self.reorder_decisions or []
+        changed = [d for d in decisions if d.get("changed")]
+        lines = [f"  reorder: {'yes' if changed else 'no'}"
+                 + (f" ({len(changed)}/{len(decisions)} regions)"
+                    if decisions else "")]
+        for d in decisions:
+            if not d.get("changed"):
+                arrow = " (kept)"
+            elif d.get("kind") == "orientation":
+                arrow = " -> same order, probe/build orientation flipped"
+            else:
+                arrow = " -> " + " * ".join(d["order"])
+            est = d.get("est_rows") or []
+            lines.append("  " + " * ".join(d["relations"]) + arrow
+                         + (f"  est rows/join: {est}" if est else ""))
+        return lines
+
     def _runtime_tree(self, node: P.PhysicalPlan, depth: int = 0) -> str:
         """Tree annotated with per-operator runtime observables (the
         SQL-UI plan graph analog of `metric/SQLMetrics.scala:40`):
@@ -266,6 +298,10 @@ class QueryExecution:
                 cap = node.out_cap
                 notes.append(f"join rows: {jr:,}"
                              + (f"/{cap:,} cap" if cap else ""))
+            if getattr(node, "cbo_est_rows", None) is not None:
+                # the reorder cost model's output estimate, next to the
+                # observed rows it is graded against
+                notes.append(f"cbo est: {node.cbo_est_rows:,}")
             slots = m.get(f"join_table_slots_{tag}")
             if slots is not None:
                 # present only when the hash kernel ran this join
@@ -1541,6 +1577,16 @@ class QueryExecution:
             # planner/AQE size predictions, graded post-hoc against the
             # metrics in this same record (history.prediction_report)
             event["predictions"] = list(self.plan_predictions)
+        if self.reorder_decisions is not None:
+            # cost-based join-reorder decisions (plan/join_reorder.py):
+            # per-region frontend order vs chosen order + estimates,
+            # served by GET /queries/<id>/plan
+            event["reorder"] = {
+                "enabled": bool(self.session.conf.get(
+                    "spark_tpu.sql.cbo.joinReorder")),
+                "changed": any(d.get("changed")
+                               for d in self.reorder_decisions),
+                "regions": list(self.reorder_decisions)}
         if self.stage_costs:
             # per-stage XLA cost/memory accounting (history.hbm_summary
             # / compile_summary read these)

@@ -41,7 +41,7 @@ def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
 
 #: event fields kept nested (object columns) rather than flattened
 _NESTED = ("spans", "stages", "shards", "predictions",
-           "analysis_findings", "plan_tree")
+           "analysis_findings", "plan_tree", "reorder")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:

@@ -111,6 +111,18 @@ class TableSource:
     def estimated_rows(self) -> Optional[int]:
         return None
 
+    def column_stats(self) -> Optional[dict]:
+        """Per-column statistics, `{name: {"min", "max", "null_count",
+        "row_groups"}}`, or None when unavailable. Parquet sources read
+        these from footers (no row data touched); consumers are the
+        reorder cost model's range selectivities
+        (plan/join_reorder.py) and the analyzer's SUM_I64_OVERFLOW
+        magnitude bounds (analysis/plan_analyzer.py). Advisory only:
+        min/max are BOUNDS over the whole dataset, never per-row
+        truth, so consumers may only use them to widen/narrow
+        estimates — never for correctness."""
+        return None
+
     def cache_token(self):
         """Identity stamp for the device-table cache; None = uncacheable.
         Must change whenever the underlying data can differ."""
@@ -303,6 +315,41 @@ class ArrowTableSource(TableSource):
     def estimated_rows(self):
         return self.table.num_rows
 
+    #: row bound above which in-memory stats are skipped: unlike a
+    #: Parquet footer read, computing them means min/max SCANS over
+    #: the whole table, and the optimize path must stay cheap
+    _STATS_MAX_ROWS = 1 << 22
+
+    def column_stats(self) -> Optional[dict]:
+        """In-memory analog of the Parquet footer read: one vectorized
+        min/max pass per numeric/temporal column, cached per source
+        (re-registering a table builds a fresh source). Tables past
+        _STATS_MAX_ROWS report no stats rather than paying full-column
+        scans during optimization."""
+        cached = getattr(self, "_column_stats", None)
+        if cached is not None:
+            return cached
+        if self.table.num_rows > self._STATS_MAX_ROWS:
+            self._column_stats = {}
+            return self._column_stats
+        stats: dict = {}
+        for name, col in zip(self.table.column_names, self.table.columns):
+            at = col.type
+            if not (pa.types.is_integer(at) or pa.types.is_floating(at)
+                    or pa.types.is_decimal(at) or at == pa.date32()):
+                continue
+            try:
+                mm = pc.min_max(col)
+                lo, hi = mm["min"].as_py(), mm["max"].as_py()
+            except Exception:  # noqa: BLE001 — stats are advisory
+                continue
+            if lo is None or hi is None:
+                continue
+            stats[name] = {"min": lo, "max": hi,
+                           "null_count": col.null_count, "row_groups": 1}
+        self._column_stats = stats
+        return stats
+
     def load(self, required_columns, pushed_filters) -> Batch:
         from ..testing import faults
         faults.fire("scan_load")  # chaos seam: host->HBM ingest edge
@@ -365,6 +412,61 @@ class ParquetSource(TableSource):
         self.path = path
         self.name = name or os.path.basename(path).split(".")[0]
         self._dataset = pa_dataset.dataset(path, format="parquet")
+        self._column_stats: Optional[dict] = None
+
+    def column_stats(self) -> Optional[dict]:
+        """Per-column min/max + null/row-group counts merged across
+        every fragment's footer row-group statistics (the C++ reader
+        exposes them without touching row data). Cached per source —
+        the source object is rebuilt on re-registration, so staleness
+        follows the same lifecycle as cache_token. A column missing
+        min/max in ANY row group is omitted entirely (a partial bound
+        is not a bound)."""
+        if self._column_stats is not None:
+            return self._column_stats
+        stats: dict = {}
+        dropped = set()
+        n_groups = 0
+        try:
+            for frag in self._dataset.get_fragments():
+                md = frag.metadata
+                for rg in range(md.num_row_groups):
+                    n_groups += 1
+                    rgm = md.row_group(rg)
+                    for ci in range(rgm.num_columns):
+                        col = rgm.column(ci)
+                        name = col.path_in_schema
+                        st = col.statistics
+                        if name in dropped:
+                            continue
+                        if st is None or not st.has_min_max:
+                            dropped.add(name)
+                            stats.pop(name, None)
+                            continue
+                        cur = stats.get(name)
+                        nulls = st.null_count if st.has_null_count \
+                            else None
+                        if cur is None:
+                            stats[name] = {"min": st.min, "max": st.max,
+                                           "null_count": nulls,
+                                           "row_groups": 1}
+                        else:
+                            cur["min"] = min(cur["min"], st.min)
+                            cur["max"] = max(cur["max"], st.max)
+                            if nulls is None:
+                                cur["null_count"] = None
+                            elif cur["null_count"] is not None:
+                                cur["null_count"] += nulls
+                            cur["row_groups"] += 1
+        except Exception:  # noqa: BLE001 — stats are advisory
+            self._column_stats = {}
+            return self._column_stats
+        # a column absent from some row group has no dataset-wide bound
+        for name in list(stats):
+            if stats[name]["row_groups"] != n_groups:
+                del stats[name]
+        self._column_stats = stats
+        return self._column_stats
 
     def cache_token(self):
         """(path, per-file (size, mtime_ns)) stamp: rewriting any file in

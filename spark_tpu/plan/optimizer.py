@@ -200,8 +200,13 @@ class PruneColumns(Rule):
 
 
 def copy_join(j: Join, left, right) -> Join:
-    return Join(left, right, j.left_keys, j.right_keys, j.how, j.condition,
-                j.null_aware)
+    new = Join(left, right, j.left_keys, j.right_keys, j.how, j.condition,
+               j.null_aware)
+    # carry the reorder cost-model annotation through rebuilds (PruneColumns
+    # runs after the JoinReorder batch and must not strip it)
+    if hasattr(j, "_cbo_est_rows"):
+        new._cbo_est_rows = j._cbo_est_rows
+    return new
 
 
 _EMPTY_BATCH = None
@@ -443,7 +448,12 @@ class RewriteGroupKeyAggregates(Rule):
         return plan.transform_up(f)
 
 
-def default_optimizer() -> RuleExecutor:
+def default_optimizer(conf=None, reorder_log=None) -> RuleExecutor:
+    """`conf` enables the conf-gated batches (cost-based join reorder);
+    without it the pipeline is the conf-independent rule set (rule unit
+    tests). `reorder_log` is a list the reorder rule appends decision
+    records to (the executor threads it into the event log)."""
+    from .join_reorder import CostBasedJoinReorder
     return RuleExecutor([
         Batch("Rewrite", [RewriteDistinctAggregates()], strategy="once"),
         Batch("Filter pushdown", [
@@ -451,6 +461,10 @@ def default_optimizer() -> RuleExecutor:
             PushFilterThroughProject(),
             PushFilterIntoScan(),
         ]),
+        # after pushdown (selectivities read the settled Filter chains),
+        # before pruning/collapse (which see the reordered tree)
+        Batch("JoinReorder", [CostBasedJoinReorder(conf, reorder_log)],
+              strategy="once"),
         Batch("Collapse", [CollapseProjectIntoAggregate()]),
         Batch("KeyAggs", [RewriteGroupKeyAggregates()], strategy="once"),
         Batch("Fold", [ConstantFolding()], strategy="once"),

@@ -1255,6 +1255,11 @@ class JoinExec(PhysicalPlan):
         self.creation_side = False
         # SQL NOT IN null-aware anti-join (left_anti only)
         self.null_aware = False
+        # reorder cost-model output estimate (plan/join_reorder.py),
+        # advisory: graded as a join_rows prediction, shown in the
+        # runtime tree — never in simple_string (stage keys must not
+        # vary with estimates)
+        self.cbo_est_rows: Optional[int] = None
         self.tag = tag
         # "shuffle": co-partition both sides (ShuffledHashJoinExec.scala:37
         # analog); "broadcast": replicate the small build side via

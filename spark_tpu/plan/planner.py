@@ -196,6 +196,10 @@ def _convert(plan: L.LogicalPlan, conf: Conf, n: int) -> P.PhysicalPlan:
                            plan.left_keys, plan.right_keys, plan.how,
                            plan.condition, plan.schema(), strategy=strategy)
         exec_.null_aware = plan.null_aware
+        # reorder cost-model estimate (plan/join_reorder.py): advisory
+        # only — graded as a `join_rows` prediction, shown by
+        # explain(runtime=True); never part of the stage key
+        exec_.cbo_est_rows = getattr(plan, "_cbo_est_rows", None)
         return exec_
     if isinstance(plan, L.WindowPlan):
         return P.WindowExec(_convert(plan.child, conf, n), plan.wexprs,

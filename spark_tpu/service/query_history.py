@@ -64,6 +64,7 @@ def detail_from_event(event) -> Dict:
         "shards": ev.get("shards") or [],
         "metrics": ev.get("metrics") or {},
         "predictions": ev.get("predictions") or [],
+        "reorder": ev.get("reorder"),
         "analysis_findings": ev.get("analysis_findings") or [],
         "fault_summary": ev.get("fault_summary"),
         "error": ev.get("error"),

@@ -459,11 +459,18 @@ class SqlService:
         if rec is None:
             return None
         detail = self.history.get(query_id) or {}
+        reorder = detail.get("reorder") or {}
         return {"query_id": query_id,
                 "status": rec.get("status"),
                 "sql": rec.get("sql"),
                 "plan": detail.get("plan"),
                 "physical": detail.get("plan_tree"),
+                # cost-based join-reorder verdict: yes/no + per-region
+                # chosen order with per-join estimated rows, so a wrong
+                # reorder is debuggable straight from the history API
+                "reorder": ("yes" if reorder.get("changed") else "no")
+                if reorder else None,
+                "reorder_regions": reorder.get("regions") or [],
                 "analysis_findings": detail.get("analysis_findings")
                 or []}
 

@@ -83,16 +83,42 @@ def test_overflow_finding_int32_plan(session):
 
 
 def test_overflow_finding_decimal_executes(session):
-    """decimal(18,0): ~60 value bits, 16 rows -> 64 > 63. Execution
-    still succeeds (non-strict): the finding is advisory and lands on
-    the QueryExecution."""
-    vals = [decimal.Decimal(i) for i in range(16)]
+    """decimal(18,0) values near the type max: ~60 value bits (by
+    dtype AND by the source's actual min/max stats), 16 rows ->
+    64 > 63. Execution still succeeds (non-strict): the finding is
+    advisory and lands on the QueryExecution. Values must GENUINELY
+    overflow since the footer/in-memory stats tightening: tiny values
+    in a wide decimal no longer flag (that false positive is exactly
+    what the stats bound removes — see the suppression test below)."""
+    vals = [decimal.Decimal(9 * 10**17 + i) for i in range(16)]
     table = pa.table({"d": pa.array(vals, type=pa.decimal128(18, 0))})
     session.register_table("ana_dec", table)
     qe = session.table("ana_dec").agg(F.sum(col("d")).alias("s"))._qe()
     out = qe.collect()
     assert out.num_rows == 1
     assert "SUM_I64_OVERFLOW" in _codes(qe.analysis_findings)
+
+
+def test_overflow_suppressed_by_column_stats(session):
+    """Small actual values in a wide decimal: the dtype alone says 60
+    bits (finding), the source min/max says 4 bits (no finding). The
+    stats bound wins — and turning stats off restores the dtype-only
+    verdict, so the suppression is attributable."""
+    vals = [decimal.Decimal(i) for i in range(16)]
+    table = pa.table({"d": pa.array(vals, type=pa.decimal128(18, 0))})
+    session.register_table("ana_dec_small", table)
+    qe = session.table("ana_dec_small") \
+        .agg(F.sum(col("d")).alias("s"))._qe()
+    qe.collect()
+    assert "SUM_I64_OVERFLOW" not in _codes(qe.analysis_findings)
+    session.conf.set("spark_tpu.sql.stats.parquetFooter", False)
+    try:
+        qe2 = session.table("ana_dec_small") \
+            .agg(F.sum(col("d")).alias("s"))._qe()
+        qe2.collect()
+        assert "SUM_I64_OVERFLOW" in _codes(qe2.analysis_findings)
+    finally:
+        session.conf.set("spark_tpu.sql.stats.parquetFooter", True)
 
 
 def test_no_overflow_on_bounded_sum(session):
