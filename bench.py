@@ -401,6 +401,12 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
         # clean run — nonzero means the TPU runtime flaked mid-stream
         # (and the stream resumed instead of restarting)
         rec0 = spark.metrics.counter("rec_chunks_replayed").value
+        # elastic-mesh sidecar baselines: gang restarts applied and
+        # rows the straggler rebalancer shifted — both MUST stay 0 on
+        # a clean single-host round; nonzero means the mesh healed
+        # (or rebalanced) mid-bench instead of degrading
+        mr0 = spark.metrics.counter("mesh_restart_attempts").value
+        rb0 = spark.metrics.counter("rebalance_rows").value
         # ingest-pipeline sidecar baselines (registry counters)
         stall0 = spark.metrics.counter("ingest_stall_ms").value
         overlap0 = spark.metrics.counter("ingest_overlap_ms").value
@@ -427,6 +433,10 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
                 c.get("peak_hbm_bytes") or 0 for c in costs))
         extra[f"tpch_{name}_sf{sf:g}_rec_chunks_replayed"] = int(
             spark.metrics.counter("rec_chunks_replayed").value - rec0)
+        extra[f"tpch_{name}_sf{sf:g}_mesh_restarts"] = int(
+            spark.metrics.counter("mesh_restart_attempts").value - mr0)
+        extra[f"tpch_{name}_sf{sf:g}_rebalanced_rows"] = int(
+            spark.metrics.counter("rebalance_rows").value - rb0)
         # hash-join kernel sidecar: per-join table build/probe program
         # cost (0.0 when every join took the sort path — expected on
         # small probes under kernelMode=auto)

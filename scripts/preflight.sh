@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Nine stages, all mandatory:
+# Ten stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -50,10 +50,16 @@
 #      6-way q19) at pandas golden parity, and the cost-based join
 #      reorder proven live — cbo.joinReorder on/off byte-identical
 #      with the reorder decisions actually changing q19's join order
+#  10. elastic mesh smoke: a fatal mesh fault injected mid-stream on an
+#      8-device virtual mesh must GANG-RESTART (mesh_restart==1, no
+#      single-device fallback), resume from the last checkpoint with
+#      at most checkpoint.everyChunks chunks replayed, and hit TPC-H
+#      Q1 golden parity
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-9 still run) for quick
-#   inner-loop checks; CI and end-of-round runs must use the default.
+#   --fast skips the full pytest suite (stages 2-10 still run) for
+#   quick inner-loop checks; CI and end-of-round runs must use the
+#   default.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -64,7 +70,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/9: tier-1 test suite --"
+    echo "-- stage 1/10: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -78,16 +84,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/9: SKIPPED (--fast) --"
+    echo "-- stage 1/10: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/9: dryrun_multichip(8) --"
+echo "-- stage 2/10: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/9: bench smoke --"
+echo "-- stage 3/10: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -119,7 +125,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/9: chaos smoke --"
+echo "-- stage 4/10: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -173,7 +179,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/9: observability + analysis smoke --"
+echo "-- stage 5/10: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -266,10 +272,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/9: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/10: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/9: SQL service smoke --"
+echo "-- stage 7/10: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -343,7 +349,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/9: join-kernel + ingest parity smoke --"
+echo "-- stage 8/10: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -401,7 +407,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/9: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/10: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -444,5 +450,55 @@ assert reordered >= 1, "join reorder never changed an order (vacuous)"
 print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
+
+echo "-- stage 10/10: elastic mesh smoke --"
+# A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
+# gang-restart the mesh — NOT degrade to single-device — resume from
+# the chunk-2 checkpoint with a bounded replay, and hit golden parity.
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python - <<'EOF6'
+import json
+import tempfile
+import warnings
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from spark_tpu import SparkTpuSession
+from spark_tpu.testing import faults
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+spark = SparkTpuSession.builder().get_or_create()
+path = tempfile.mkdtemp(prefix="preflight_elastic_") + "/sf"
+write_parquet(path, 0.001)
+Q.register_tables(spark, path)
+conf = spark.conf
+conf.set("spark_tpu.execution.backoffMs", 1)
+conf.set("spark_tpu.sql.execution.streamingChunkRows", 1024)
+conf.set("spark_tpu.sql.io.deviceCacheBytes", 0)
+conf.set("spark_tpu.sql.mesh.size", 8)
+conf.set("spark_tpu.execution.checkpoint.everyChunks", 2)
+
+rec0 = spark.metrics.counter("rec_chunks_replayed").value
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")  # the restart warnings are the point
+    with faults.inject(conf, "mesh_checkpoint:fatal:2") as fp:
+        qe = Q.QUERIES["q1"](spark)._qe()
+        got = G.normalize_decimals(qe.collect().to_pandas())
+assert fp.fired_log, "mesh_checkpoint seam never fired — smoke is vacuous"
+assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+assert "mesh_fallback" not in qe.fault_summary, qe.fault_summary
+assert qe.fault_summary.get("checkpoint_restore") == 1, qe.fault_summary
+replayed = spark.metrics.counter("rec_chunks_replayed").value - rec0
+assert replayed <= 2, f"replayed {replayed} chunks > everyChunks=2"
+conf.set("spark_tpu.sql.mesh.size", 0)
+G.compare(got.reset_index(drop=True), G.GOLDEN["q1"](path))
+print(json.dumps({"preflight_elastic_smoke": "ok",
+                  "replayed_chunks": int(replayed),
+                  "fault_summary": dict(qe.fault_summary)}))
+EOF6
 
 echo "== preflight PASSED =="

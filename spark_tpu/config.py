@@ -280,6 +280,73 @@ CHECKPOINT_EVERY_CHUNKS = register(
         "restarts from scratch).",
     validator=lambda v: v >= 0)
 
+MESH_RESTART_ENABLED = register(
+    "spark_tpu.execution.meshRestart.enabled", True,
+    doc="Gang restart (parallel/elastic.py): on a mesh/collective "
+        "failure, re-execute the query still MESH-planned — up to "
+        "meshRestart.maxRestarts attempts with exponential backoff — "
+        "before degrading to the single-device fallback. The mesh "
+        "streaming driver resumes at its last checkpoint "
+        "(checkpoint.everyChunks), so a host lost mid-stream replays "
+        "at most one checkpoint interval ON the mesh. Restarts are "
+        "recorded as `mesh_restart` actions (mesh_restart_attempts "
+        "counter); disabled, mesh failure degrades straight to "
+        "single-device (the pre-elastic PR-5 behavior).")
+
+MESH_RESTART_MAX = register(
+    "spark_tpu.execution.meshRestart.maxRestarts", 2,
+    doc="Gang-restart budget per query execution: mesh failures past "
+        "it fall through to the single-device fallback rung. Backoff "
+        "follows spark_tpu.execution.backoffMs (exponential, "
+        "jittered).",
+    validator=lambda v: v >= 0)
+
+DECOMMISSION_SHARDS = register(
+    "spark_tpu.execution.decommission.shards", "",
+    doc="Graceful-decommission drain request (comma-separated mesh "
+        "positions, e.g. '3' or '3,5'; session.decommission_shards() "
+        "sets it): a running mesh stream drains at its NEXT chunk "
+        "boundary — checkpoint forced at the current cursor, "
+        "`decommission` recorded, the shards' devices excluded at "
+        "session level (spark_tpu.sql.mesh.excludeDevices) — and the "
+        "query continues on the reduced gang from the checkpoint. The "
+        "BlockManagerDecommissioner analog. One-shot: cleared once "
+        "applied; a request with NO position valid for the next mesh "
+        "query's gang is discarded with a warning (never left armed "
+        "for a future larger mesh).")
+
+MESH_EXCLUDE_DEVICES = register(
+    "spark_tpu.sql.mesh.excludeDevices", "",
+    doc="Comma-separated device ids never meshed over (written by the "
+        "decommission drain; settable directly to pin out a bad "
+        "device). get_mesh builds the gang over the surviving pool — "
+        "shrinking below mesh.size instead of failing. Limitation: "
+        "a pool of <= 1 survivors degrades to the SINGLE-CHIP path, "
+        "which places on the process's JAX default device and does "
+        "not consult this list — excluding the default device itself "
+        "requires restarting with JAX visible-device flags.")
+
+STRAGGLER_REBALANCE_ENABLED = register(
+    "spark_tpu.sql.straggler.rebalance.enabled", True,
+    doc="Straggler mitigation (parallel/elastic.py): when the "
+        "StragglerMonitor flags a shard mid-stream, re-assign "
+        "subsequent chunks' rows away from it — the flagged shard's "
+        "live-row share drops by straggler.rebalance.maxSkew, spread "
+        "over the healthy shards. Partial aggregation is "
+        "row-assignment independent: integer/decimal results are "
+        "bit-exact; float sums may move in the last ulp (summation "
+        "order), as with any mesh-size change. Recorded as "
+        "`shard_rebalance` with the rebalance_rows counter.")
+
+STRAGGLER_REBALANCE_MAX_SKEW = register(
+    "spark_tpu.sql.straggler.rebalance.maxSkew", 0.5,
+    doc="How much of a flagged shard's fair row share the rebalancer "
+        "may shift to healthy shards (0.5 = the straggler steps over "
+        "half its fair share). Bounds the skew so one bad detection "
+        "cannot starve a shard entirely; 0 disables movement.",
+    validator=lambda v: 0.0 <= v < 1.0,
+    type_=float)
+
 MESH_FALLBACK_ENABLED = register(
     "spark_tpu.execution.meshFallback.enabled", True,
     doc="When a distributed run fails inside the mesh/collective path "

@@ -73,6 +73,9 @@ class QueryExecution:
         self._mesh_fallback = False
         self._oom_rung = 0
         self._retry_policy = None
+        # elastic-mesh gang-restart budget (parallel/elastic.py),
+        # created per execute_batch like the retry policy
+        self._elastic = None
         self._last_stage_key: Optional[str] = None
         self.fault_summary: Dict[str, object] = {}
         self.fault_events: list = []
@@ -860,11 +863,22 @@ class QueryExecution:
         ladder, mesh failures re-plan single-device — all recorded in
         `fault_summary` and the event log."""
         from ..observability.listener import QueryStartEvent
+        from ..parallel.elastic import ElasticMeshState
         from ..service import arbiter as res_arbiter
         from ..testing import faults
         from .failures import RetryPolicy
         from .recovery import RecoveryContext
         self._activate_conf()
+        # degraded-mode state was sticky across executions of one
+        # QueryExecution: a warm-loop re-execution after a transient
+        # mesh failure stayed pinned single-device (and an OOM reroute
+        # stayed spill-routed) forever. Every execution starts
+        # optimistic — the ladder re-derives whatever it still needs.
+        # A plan built under the old overlay must be rebuilt.
+        if self._exec_conf is not None or self._mesh_fallback:
+            self._executed = None
+        self._exec_conf = None
+        self._mesh_fallback = False
         faults.arm(self.session.conf)
         # cross-query arbiter lease scope (service/arbiter.py): scans
         # this execution keeps resident lease from the shared HBM pool;
@@ -886,6 +900,7 @@ class QueryExecution:
         self._retry_policy = RetryPolicy(
             max_retries=self._max_retries(conf),
             backoff_ms=float(conf.get("spark_tpu.execution.backoffMs")))
+        self._elastic = ElasticMeshState(conf)
         self._observe_events = self._events_enabled()
         if self._observe_events:
             self.session.listeners.post("on_query_start", QueryStartEvent(
@@ -964,6 +979,21 @@ class QueryExecution:
                 query_id=self.query_id, ts=time.time(), action=action,
                 error=error, site=site))
 
+    def _mesh_replan(self, mesh_size: Optional[int] = None) -> None:
+        """Shared reset for the elastic-ladder rungs that change the
+        gang's shape (drain, shrink-on-restart, single-device
+        fallback): memoized stage outputs can no longer splice
+        (checkpoints survive — the next stream resumes from them), and
+        the plan rebuilds — under a mesh.size overlay when given, else
+        against the conf whose device exclusions just changed."""
+        if self._recovery is not None:
+            self._recovery.invalidate()
+        if mesh_size is not None:
+            overlay = Conf(parent=self._conf)
+            overlay.set("spark_tpu.sql.mesh.size", mesh_size)
+            self._exec_conf = overlay
+        self._executed = None
+
     def _execute_recover(self) -> Tuple[Batch, Dict, Dict]:
         """Run `_execute_batch_inner` under the failure taxonomy: each
         iteration either returns, re-raises (_ReplanRequest, FATAL,
@@ -994,27 +1024,74 @@ class QueryExecution:
         cls = classify(e)
         msg = f"{type(e).__name__}: {e}"
 
-        # mesh/collective failure: re-plan single-device (degraded but
-        # correct — the reference reschedules off a lost executor the
-        # same way), regardless of the failure class
+        # graceful decommission (parallel/elastic.py): a drain request
+        # surfaced at a chunk boundary — a planned transition, not a
+        # failure. Exclude the draining devices at SESSION level (the
+        # decommission outlives this query), clear the one-shot
+        # request, and re-execute on the reduced gang, which resumes
+        # from the checkpoint the drain just forced.
+        from ..parallel import elastic as EL
         mesh_on = int(conf.get("spark_tpu.sql.mesh.size")) > 1
-        if mesh_on and not self._mesh_fallback and is_mesh_failure(e) \
-                and bool(conf.get("spark_tpu.execution.meshFallback.enabled")):
-            warnings.warn(f"mesh stage failure, re-planning single-device "
-                          f"(mesh_fallback): {msg[:160]}")
-            self._record_fault("mesh_fallback", e)
-            self._mesh_fallback = True
+        if mesh_on and isinstance(e, EL.MeshDecommissionRequest):
+            warnings.warn(
+                f"decommissioning mesh shard(s) {sorted(e.shards)} "
+                f"(device ids {sorted(e.device_ids)}): draining at the "
+                f"chunk boundary and continuing on the reduced gang")
+            self._record_fault("decommission", None,
+                               shards=sorted(e.shards),
+                               devices=sorted(e.device_ids))
+            EL.apply_decommission(self.session.conf, e.device_ids)
             if self._recovery is not None:
-                # single-device shapes differ: memoized mesh-stage
-                # outputs cannot splice (checkpoints survive — the
-                # fallback resumes the stream from them)
-                self._recovery.invalidate()
                 self._recovery.begin_recovery_attempt()
-            overlay = Conf(parent=conf)
-            overlay.set("spark_tpu.sql.mesh.size", 0)
-            self._exec_conf = overlay
-            self._executed = None  # re-plan without exchanges/sharding
+            self._mesh_replan()  # the gang shrank: [n, ...] shapes differ
             return
+
+        # mesh/collective failure ladder: gang restart first — the
+        # mesh streaming driver resumes at its last checkpoint ON the
+        # mesh — and only past the restart budget the single-device
+        # fallback (degraded but correct), the final rung. Each rung
+        # is gated by its OWN conf: meshFallback.enabled=false still
+        # restarts (mesh-or-fail), it just removes the degrade rung.
+        if mesh_on and not self._mesh_fallback and is_mesh_failure(e):
+            # a pool of <= 1 survivors cannot host a gang: skip the
+            # restart rung (a re-mesh would be single-device anyway —
+            # that is exactly what the fallback rung below does)
+            healthy = EL.healthy_device_count(conf)
+            restartable = healthy is None or healthy > 1
+            slept = self._elastic.try_restart(self._record_fault) \
+                if restartable and self._elastic is not None else None
+            if slept is not None:
+                warnings.warn(
+                    f"mesh stage failure, gang-restarting the mesh "
+                    f"(attempt {self._elastic.restarts}/"
+                    f"{self._elastic.max_restarts}, backoff "
+                    f"{slept:.0f}ms): {msg[:160]}")
+                self._record_fault("mesh_restart", e,
+                                   attempt=self._elastic.restarts,
+                                   backoff_ms=round(slept, 1))
+                self.session.metrics.counter("mesh_restart_attempts").inc()
+                if self._recovery is not None:
+                    self._recovery.begin_recovery_attempt()
+                # re-probe the healthy pool: a genuinely lost host
+                # shrinks the gang instead of failing the re-mesh —
+                # smaller n changes shapes, so memoized outputs drop
+                n_conf = int(conf.get("spark_tpu.sql.mesh.size"))
+                if healthy is not None and 1 < healthy < n_conf:
+                    self._mesh_replan(mesh_size=healthy)
+                return
+            if bool(conf.get(
+                    "spark_tpu.execution.meshFallback.enabled")):
+                warnings.warn(
+                    f"mesh stage failure, re-planning single-device "
+                    f"(mesh_fallback): {msg[:160]}")
+                self._record_fault("mesh_fallback", e)
+                self._mesh_fallback = True
+                if self._recovery is not None:
+                    self._recovery.begin_recovery_attempt()
+                self._mesh_replan(mesh_size=0)  # no exchanges/sharding
+                return
+            # no degrade rung (meshFallback.enabled=false): the
+            # classification rungs below decide, like pre-elastic
 
         if cls in (FailureClass.TRANSIENT, FailureClass.TIMEOUT):
             slept = self._retry_policy.attempt_retry()
@@ -1163,6 +1240,11 @@ class QueryExecution:
         from ..testing import faults
         from .failures import StageTimeoutError
         mesh = get_mesh(self._conf)
+        if mesh is not None:
+            # a drain request no gang this size can ever apply must
+            # not stay armed for a future larger mesh
+            from ..parallel.elastic import discard_stale_decommission
+            discard_stale_decommission(self.session.conf, mesh)
         # seed capacities a previous execution of this plan discovered,
         # so repeated queries skip the overflow->re-jit ramp entirely.
         # The key includes every scan's source identity stamp: caps

@@ -101,12 +101,14 @@ def classify(exc: BaseException) -> FailureClass:
 
 def is_mesh_failure(exc: BaseException) -> bool:
     """True when the failure points at the mesh/collective path (or a
-    synthetic fault at the `mesh` / `mesh_checkpoint` sites — the
-    latter models a host lost mid-stream at a snapshot point): the
-    candidate set for the single-device fallback re-plan."""
+    synthetic fault at the `mesh` / `mesh_checkpoint` / `decommission`
+    sites — mesh_checkpoint models a host lost mid-stream at a
+    snapshot point, decommission a drain that died at its boundary):
+    the candidate set for the elastic recovery ladder (gang restart ->
+    single-device fallback)."""
     from ..testing.faults import FaultInjected
     if isinstance(exc, FaultInjected):
-        return exc.site in ("mesh", "mesh_checkpoint")
+        return exc.site in ("mesh", "mesh_checkpoint", "decommission")
     msg = f"{type(exc).__name__}: {exc}"
     return any(t in msg for t in _MESH_TOKENS)
 

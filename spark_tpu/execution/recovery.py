@@ -160,6 +160,12 @@ class RecoveryContext:
         self._memo: Dict[Tuple, Tuple[int, int, Any]] = {}
         self.epoch = 0
         self.checkpoints: Dict[str, MeshCheckpoint] = {}
+        # per-stream progress watermark (checkpoint key -> chunks
+        # consumed): lets a checkpoint restore report exactly how many
+        # chunks the replay re-covers (progress - cursor), bounding the
+        # elastic-mesh replay proof. Survives invalidate() like the
+        # checkpoints it measures against.
+        self._progress: Dict[str, int] = {}
         # set by _handle_failure once any recovery action was applied:
         # memo hits before the first failure are intra-attempt dedup,
         # not recovery, and must not pollute fault_summary
@@ -239,9 +245,29 @@ class RecoveryContext:
     def get_checkpoint(self, key: str) -> Optional[MeshCheckpoint]:
         return self.checkpoints.get(key)
 
+    def note_progress(self, key: str, chunks: int) -> None:
+        """Advance the stream's consumed-chunk watermark (monotone)."""
+        if int(chunks) > self._progress.get(key, 0):
+            self._progress[key] = int(chunks)
+
+    def progress(self, key: str) -> int:
+        return self._progress.get(key, 0)
+
+    def restore_replayed(self, key: str, cursor: int) -> int:
+        """Chunks the resume at `cursor` re-covers (the failed attempt
+        had consumed up to the watermark): counted into
+        rec_chunks_replayed so the bounded-replay proof — at most
+        checkpoint.everyChunks chunks per mesh recovery — is a metric,
+        not an inference."""
+        replayed = max(0, self.progress(key) - int(cursor))
+        if replayed and self.metrics is not None:
+            self.metrics.counter("rec_chunks_replayed").inc(replayed)
+        return replayed
+
     def release(self) -> None:
         """Drop retained stage outputs (device batches) and checkpoint
         tables when the execution finishes — the memo exists to span
         recovery loops, not executions."""
         self._memo.clear()
         self.checkpoints.clear()
+        self._progress.clear()

@@ -43,6 +43,11 @@ def conf_compile_suffix(conf) -> str:
     return (f"#k{conf.get('spark_tpu.sql.aggregate.kernelMode')}"
             f"#d{conf.get('spark_tpu.sql.aggregate.maxDirectDomain')}"
             f"#g{conf.get('spark_tpu.sql.execution.bucketGrowth')}"
+            # mesh composition: shard_map closes over the Mesh object,
+            # so a decommission that changed the device pool (same n,
+            # different devices) must not reuse a program compiled
+            # over a mesh containing the drained device
+            f"#x{conf.get('spark_tpu.sql.mesh.excludeDevices')}"
             # join kernel choice + table-shape confs are baked into the
             # traced probe/build programs (execution/hash_join.py)
             f"#j{conf.get('spark_tpu.sql.join.kernelMode')}"
@@ -647,8 +652,10 @@ def resume_from_mesh_checkpoint(agg: "P.HashAggregateExec", conf,
                                       seed_partials=[ck.table])
     if out is None:
         return None
+    replayed = recovery.restore_replayed(ck.key, ck.cursor)
     recovery.record("checkpoint_restore", None, cursor=int(ck.cursor),
-                    ckpt_rows=int(ck.table.num_rows))
+                    ckpt_rows=int(ck.table.num_rows),
+                    chunks_replayed=replayed)
     return out
 
 
@@ -682,7 +689,6 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     import jax
     from jax.sharding import PartitionSpec as Psp
     from ..parallel.mesh import shard_map
-    from ..parallel import pad_batch_to_multiple
     from ..parallel.mesh import AXIS
 
     if agg.mode != "partial":
@@ -710,17 +716,50 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
 
     from ..io.sources import maybe_prefetch
     from ..observability.spans import current_shard_telemetry
+    from ..parallel import elastic as EL
+    import pyarrow as pa
     import time as _time
     n = int(mesh.devices.size)
     telem = current_shard_telemetry()
+    needs_base = any(a.func.uses_row_base for a in agg.agg_exprs)
+    every = int(conf.get(CHECKPOINT_EVERY_KEY))
+    # position-packed aggregates are excluded from checkpoint/resume
+    # AND rebalance — their packed row bases encode assignment order
+    ck_key = checkpoint_key(agg, leaf, chunk_rows) \
+        if recovery is not None and not needs_base else None
+    save_key = ck_key if every > 0 else None
+    # elastic resume: a gang restart (or decommission re-execution)
+    # re-enters this driver with the failed stream's checkpoint intact
+    # — skip the covered chunks and merge the checkpointed partial
+    # rows at emit, so the recovery replays at most everyChunks chunks
+    # ON the mesh (the mesh-side analog of resume_from_mesh_checkpoint)
+    ck = recovery.get_checkpoint(ck_key) if ck_key is not None else None
     chunks = maybe_prefetch(
         leaf.source.load_chunks(leaf.required_columns,
                                 leaf.pushed_filters, chunk_rows),
         conf, recovery)
+    if ck is not None:
+        if not hasattr(chunks, "skip_chunks") or \
+                chunks.skip_chunks(ck.cursor) < ck.cursor:
+            return None  # stream shorter than the cursor: unmatchable
+
+    def record_restore():
+        replayed = recovery.restore_replayed(ck_key, ck.cursor)
+        recovery.record("checkpoint_restore", None,
+                        cursor=int(ck.cursor),
+                        ckpt_rows=int(ck.table.num_rows),
+                        chunks_replayed=replayed, driver="mesh")
+
     t_in0 = _time.perf_counter()
     first = next(iter(chunks), None)
     t_in1 = _time.perf_counter()
     if first is None:
+        if ck is not None:
+            # resume landed exactly at end-of-stream: the checkpoint
+            # already covers every chunk — its partial rows ARE the
+            # stream's result (the exchange + final above re-reduce)
+            record_restore()
+            return Batch.from_arrow(ck.table)
         return None
     key = (f"stream_mesh:{agg.describe()}:{chunk_rows}:{n}"
            + conf_compile_suffix(conf))
@@ -775,16 +814,22 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
 
     check_dicts = _dict_growth_guard(agg, prep)
     chunk_base = 0
-    needs_base = any(a.func.uses_row_base for a in agg.agg_exprs)
 
     def row_width(b):
         return sum(c.data.dtype.itemsize
                    + (1 if c.validity is not None else 0)
                    for c in b.columns.values())
 
+    # straggler rebalancing (parallel/elastic.py): inert until the
+    # ElasticRebalancer flags a shard via on_straggler, then each
+    # chunk's rows skew away from it. Position-packed aggregates keep
+    # the even split (their packed bases encode assignment).
+    rebal = EL.RebalanceState(n, conf, recovery=recovery) \
+        if not needs_base else None
+
     def step(tables, b, ci):
         nonlocal chunk_base
-        padded = pad_batch_to_multiple(b, n)
+        padded = EL.pad_chunk_for_shards(b, n, rebal)
         if needs_base and chunk_base + padded.capacity >= (1 << 30):
             raise RuntimeError(
                 "first/last over a streamed mesh scan exceeds the 2^30 "
@@ -807,35 +852,68 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
         # device->host checkpoint of the accumulator state: emit the
         # per-shard partial rows (the exact shape a FINAL aggregate
         # consumes) and decode them against the dictionaries grown so
-        # far — every code folded so far is covered (append-only)
-        return _with_dict_overrides(emit_step(tables),
-                                    current_dicts()).to_arrow()
+        # far — every code folded so far is covered (append-only). A
+        # RESUMED stream's accumulators only cover the post-cursor
+        # chunks: prepend the seed checkpoint so a later restore never
+        # loses the head of the stream.
+        t = _with_dict_overrides(emit_step(tables),
+                                 current_dicts()).to_arrow()
+        if ck is not None:
+            t = pa.concat_tables([ck.table, t],
+                                 promote_options="permissive")
+        return t
 
-    # chunk-granular retry + periodic checkpoint (execution/recovery.py):
-    # position-packed aggregates are excluded from checkpointing — their
-    # packed row bases are per-run and would not merge with a resume
-    every = int(conf.get(CHECKPOINT_EVERY_KEY))
-    ck_key = checkpoint_key(agg, leaf, chunk_rows) \
-        if recovery is not None and every > 0 and not needs_base else None
+    # chunk-granular retry + periodic checkpoint (execution/recovery.py)
+    if ck is not None:
+        # the bundle exists and the cursor was skipped: the resume is
+        # definitely running — record it (with its bounded replay)
+        record_restore()
     retrier = ChunkRetrier(conf, recovery)
-    ci = 0
+    ci = int(ck.cursor) if ck is not None else 0
     b = first
-    while b is not None:
-        if telem is not None:
-            telem.chunk_ingested(ci, b.capacity,
-                                 b.capacity * row_width(b), t_in0, t_in1)
-        check_dicts(b)
-        tables = retrier.run(lambda bb=b: step(tables, bb, ci), chunk=ci)
-        ci += 1
-        if ck_key is not None and ci % every == 0:
-            recovery.save_checkpoint(ck_key, ci, snapshot)
-        t_in0 = _time.perf_counter()
-        b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
-        t_in1 = _time.perf_counter()
+    with EL.use_rebalance(rebal):
+        while b is not None:
+            # graceful decommission: a pending drain request applies at
+            # the chunk boundary — checkpoint forced at the current
+            # cursor so the reduced gang resumes here, then the request
+            # surfaces to the executor, which excludes the draining
+            # devices and re-executes. The `decommission` seam fires
+            # FIRST: a fault injected there models the drain machinery
+            # dying, and rides the normal mesh ladder.
+            drain, drain_ids = EL.pending_decommission(conf, mesh)
+            if drain:
+                from ..testing import faults
+                faults.fire("decommission")
+                if save_key is not None and ci > 0:
+                    recovery.save_checkpoint(save_key, ci, snapshot)
+                raise EL.MeshDecommissionRequest(drain, drain_ids)
+            if telem is not None:
+                telem.chunk_ingested(ci, b.capacity,
+                                     b.capacity * row_width(b),
+                                     t_in0, t_in1)
+            check_dicts(b)
+            tables = retrier.run(lambda bb=b: step(tables, bb, ci),
+                                 chunk=ci)
+            ci += 1
+            if ck_key is not None:
+                # consumed-chunk watermark: bounds the replay a later
+                # checkpoint restore reports (restore_replayed)
+                recovery.note_progress(ck_key, ci)
+            if save_key is not None and ci % every == 0:
+                recovery.save_checkpoint(save_key, ci, snapshot)
+            t_in0 = _time.perf_counter()
+            b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
+            t_in1 = _time.perf_counter()
 
     if telem is not None:
         telem.finish()  # flush the last chunk's buffered records
-    return _with_dict_overrides(emit_step(tables), current_dicts())
+    out = _with_dict_overrides(emit_step(tables), current_dicts())
+    if ck is not None:
+        # merge the seed checkpoint's partial rows with the resumed
+        # tail's — the FINAL aggregate above re-reduces both
+        out = Batch.from_arrow(pa.concat_tables(
+            [ck.table, out.to_arrow()], promote_options="permissive"))
+    return out
 
 
 def _prefer_resident(leaf: "P.ScanExec", conf) -> bool:

@@ -80,10 +80,13 @@ def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
 #: recovery-action counters an execution's fault_summary may carry
 #: (executor._record_fault actions + the aggregate backoff total).
 #: chunk_retry / stage_reuse / checkpoint_restore are the
-#: partial-progress actions (execution/recovery.py).
+#: partial-progress actions (execution/recovery.py); mesh_restart /
+#: decommission / shard_rebalance are the elastic-mesh actions
+#: (parallel/elastic.py).
 FAULT_ACTIONS = ("transient_retry", "stage_timeout", "oom_cache_evict",
                  "oom_spill_reroute", "mesh_fallback", "chunk_retry",
-                 "stage_reuse", "checkpoint_restore")
+                 "stage_reuse", "checkpoint_restore", "mesh_restart",
+                 "decommission", "shard_rebalance")
 
 
 def fault_summary(events: pd.DataFrame) -> pd.DataFrame:

@@ -63,6 +63,11 @@ METRIC_PREFIXES = (
     # straggler detection (observability/straggler.py): REGISTRY
     # counter, listed for namespace closure like the ingest pair
     "straggler_",      # straggler_flagged: shards flagged this process
+    # elastic mesh (parallel/elastic.py): REGISTRY counters, listed
+    # for namespace closure — gang restarts applied and live rows the
+    # straggler rebalancer shifted off flagged shards
+    "mesh_restart_",   # mesh_restart_attempts: gang restarts applied
+    "rebalance_",      # rebalance_rows: rows shifted off flagged shards
 )
 
 

@@ -200,12 +200,15 @@ class MetricsSinkListener(QueryListener):
 def install_default_listeners(session) -> None:
     """Register the built-in subscribers on a session's bus (order
     matters only for determinism: event log, trace, metrics,
-    straggler monitor)."""
+    straggler monitor, elastic rebalancer — the rebalancer AFTER the
+    monitor that feeds it)."""
+    from ..parallel.elastic import ElasticRebalancer
     from .straggler import StragglerMonitor
     session.listeners.register(EventLogListener(session))
     session.listeners.register(ChromeTraceListener(session))
     session.listeners.register(MetricsSinkListener(session))
     session.listeners.register(StragglerMonitor(session))
+    session.listeners.register(ElasticRebalancer())
 
 
 def make_app_id() -> str:

@@ -34,25 +34,56 @@ def shard_map(f, *args, **kwargs):
 
 AXIS = "data"
 
+EXCLUDE_KEY = "spark_tpu.sql.mesh.excludeDevices"
+
 
 def mesh_size(conf) -> int:
     n = int(conf.get("spark_tpu.sql.mesh.size"))
     return max(1, n)
 
 
+def excluded_device_ids(conf) -> set:
+    """Decommissioned device ids (spark_tpu.sql.mesh.excludeDevices):
+    drained by the elastic-mesh layer (parallel/elastic.py) or pinned
+    by an operator — never meshed over again this session. Malformed
+    entries WARN (an operator's typo'd pin-out silently keeping the
+    bad device in the gang would be worse than noise)."""
+    from .elastic import _parse_int_set
+    return _parse_int_set(conf.get(EXCLUDE_KEY))
+
+
 def get_mesh(conf) -> Optional[Mesh]:
-    """Build the 1-D data mesh from conf, or None for single-chip."""
+    """Build the 1-D data mesh from conf, or None for single-chip.
+
+    With no exclusions a short device pool is a setup ERROR (the
+    remediation-hint diagnostic below). With exclusions — a graceful
+    decommission drained part of the gang — the mesh shrinks to the
+    surviving pool instead: elasticity means a smaller gang, not a
+    failed query. A pool of <= 1 survivors degrades to single-chip,
+    which runs on the process's JAX DEFAULT device without consulting
+    the exclusion list (see the excludeDevices conf doc) — excluding
+    the default device needs JAX visible-device flags, not conf."""
     n = mesh_size(conf)
     if n <= 1:
         return None
     init_distributed(conf)  # no-op unless cluster.coordinator is set
     devices = jax.devices()
+    import numpy as np
     if len(devices) < n:
+        # a pool short even BEFORE exclusions is a setup error, never
+        # elasticity — exclusions must not swallow the diagnostic
         raise RuntimeError(
             f"mesh.size={n} but only {len(devices)} devices visible "
             f"({[d.platform for d in devices[:4]]}...); for CI use "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
-    import numpy as np
+    excluded = excluded_device_ids(conf)
+    if excluded:
+        pool = [d for d in devices
+                if int(getattr(d, "id", -1)) not in excluded]
+        n = min(n, len(pool))
+        if n <= 1:
+            return None
+        return Mesh(np.array(pool[:n]), (AXIS,))
     return Mesh(np.array(devices[:n]), (AXIS,))
 
 

@@ -125,6 +125,15 @@ class SparkTpuSession(metaclass=_ActiveSessionMeta):
     addListener = add_listener
     removeListener = remove_listener
 
+    def decommission_shards(self, shards) -> None:
+        """Gracefully drain the given mesh positions (elastic mesh,
+        parallel/elastic.py): a running mesh stream checkpoints at its
+        next chunk boundary and continues on the reduced gang; the
+        drained devices stay excluded for later queries. The
+        BlockManagerDecommissioner seat."""
+        from .parallel.elastic import decommission_shards
+        decommission_shards(self, shards)
+
     # -- data cache ---------------------------------------------------------
 
     @staticmethod

@@ -15,7 +15,8 @@ Grammar (comma-separated rules):
     rule  := site ":" fault ":" nth [":" arg]
     site  := scan_load | stage_compile | stage_run | shuffle
              | join_build | mesh | stream_chunk | mesh_checkpoint
-             | ingest_prefetch | shard_chunk
+             | ingest_prefetch | shard_chunk | mesh_restart
+             | decommission
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
@@ -47,7 +48,13 @@ before the snapshot is taken; `shard_chunk` fires once per
 (chunk, shard) inside the per-shard telemetry's timed wait window
 (observability/spans.py — hit ordinal chunk * n_shards + shard + 1),
 so a `slow` rule models exactly one straggling shard for the
-StragglerMonitor chaos tests.
+StragglerMonitor chaos tests; `mesh_restart` fires at each
+gang-restart attempt boundary (parallel/elastic.py — a raising rule
+fails THAT attempt, consuming its budget, so `mesh_restart:fatal`
+proves the ladder still lands on single-device fallback);
+`decommission` fires at the drain boundary, before the forced
+checkpoint (a raising rule models the drain machinery dying and rides
+the normal mesh ladder).
 """
 
 from __future__ import annotations
@@ -66,7 +73,8 @@ INJECT_KEY = "spark_tpu.faults.inject"
 #: then silently never fire, so the chaos test tested nothing.
 KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "join_build", "mesh", "stream_chunk", "mesh_checkpoint",
-               "ingest_prefetch", "shard_chunk")
+               "ingest_prefetch", "shard_chunk", "mesh_restart",
+               "decommission")
 
 #: test-registered extra seams (register_site): code under test may
 #: plant its own fire() points without editing the built-in tuple

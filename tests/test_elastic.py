@@ -1,0 +1,598 @@
+"""Elastic-mesh recovery suite (spark_tpu/parallel/elastic.py): gang
+restart from checkpoint, graceful decommission, and straggler chunk
+rebalancing — the mitigation half of the ROADMAP elastic-mesh item.
+
+The acceptance bar (ISSUE 11): with a mesh fault injected mid-stream,
+the query completes ON THE MESH (not single-device), replays at most
+`checkpoint.everyChunks` chunks (proven via `rec_chunks_replayed`),
+and results are identical to the fault-free run; restart, decommission
+and rebalance all observable in fault_summary and history."""
+
+import warnings
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+from spark_tpu.observability import QueryListener
+from spark_tpu.testing import faults
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+SF = 0.002
+CHUNK_KEY = "spark_tpu.sql.execution.streamingChunkRows"
+CACHE_KEY = "spark_tpu.sql.io.deviceCacheBytes"
+MESH_KEY = "spark_tpu.sql.mesh.size"
+CKPT_KEY = "spark_tpu.execution.checkpoint.everyChunks"
+RESTART_KEY = "spark_tpu.execution.meshRestart.enabled"
+RESTART_MAX_KEY = "spark_tpu.execution.meshRestart.maxRestarts"
+DRAIN_KEY = "spark_tpu.execution.decommission.shards"
+EXCLUDE_KEY = "spark_tpu.sql.mesh.excludeDevices"
+SPANS_KEY = "spark_tpu.sql.observability.shardSpans"
+REBALANCE_KEY = "spark_tpu.sql.straggler.rebalance.enabled"
+MAX_SKEW_KEY = "spark_tpu.sql.straggler.rebalance.maxSkew"
+
+
+@pytest.fixture(scope="session")
+def tpch_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("tpch_elastic") / "sf_small")
+    write_parquet(path, SF)
+    return path
+
+
+@pytest.fixture(scope="session")
+def tpch_session(session, tpch_path):
+    Q.register_tables(session, tpch_path)
+    return session
+
+
+@pytest.fixture(autouse=True)
+def streaming_conf(tpch_session):
+    """Chunked mesh streaming on every query; millisecond backoffs;
+    disarmed plan. The conftest conf guard restores every key."""
+    conf = tpch_session.conf
+    conf.set("spark_tpu.execution.backoffMs", 1)
+    conf.set(CHUNK_KEY, 1024)  # lineitem@SF0.002 ~ 12k rows -> ~12 chunks
+    conf.set(CACHE_KEY, 0)
+    faults.reset()
+    yield conf
+    faults.reset()
+
+
+def _cold(session):
+    from spark_tpu.io.device_cache import CACHE
+    session._stage_cache.clear()
+    session._aqe_caps.clear()
+    CACHE.clear()
+
+
+def _run_query(session, qname):
+    qe = Q.QUERIES[qname](session)._qe()
+    got = G.normalize_decimals(qe.collect().to_pandas()) \
+        .reset_index(drop=True)
+    return got, qe
+
+
+def _check_golden(got, tpch_path, qname):
+    G.compare(got, G.GOLDEN[qname](tpch_path))
+
+
+def _replayed(session):
+    return session.metrics.counter("rec_chunks_replayed").value
+
+
+def _restarts(session):
+    return session.metrics.counter("mesh_restart_attempts").value
+
+
+def _mesh_stream_qe(session, n_rows=16000, name="elastic_t", mod=13):
+    pdf = pd.DataFrame({"v": np.arange(n_rows, dtype=np.int64)})
+    session.register_table(name, pdf)
+    qe = (session.table(name)
+          .group_by((col("v") % mod).alias("k"))
+          .agg(F.sum(col("v")).alias("s")))._qe()
+    return qe, pdf
+
+
+def _groupsum_parity(got, pdf, mod=13):
+    want = pdf.assign(k=pdf.v % mod).groupby("k")["v"].sum()
+    res = got.set_index("k")["s"].sort_index()
+    assert (res == want).all(), (res, want)
+
+
+# -- gang restart ------------------------------------------------------------
+
+def test_kill_one_host_converges_on_mesh(tpch_session, tpch_path,
+                                         streaming_conf):
+    """THE acceptance scenario: a host lost mid-stream (fatal at the
+    2nd snapshot point) gang-restarts the mesh, resumes at the chunk-2
+    checkpoint ON the mesh — never single-device — replays at most
+    `checkpoint.everyChunks` chunks, and hits golden parity."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(CKPT_KEY, 2)
+    before, restarts0 = _replayed(tpch_session), _restarts(tpch_session)
+    with faults.inject(streaming_conf, "mesh_checkpoint:fatal:2") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert plan.fired_log == [("mesh_checkpoint", 2, "fatal")]
+    assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+    assert "mesh_fallback" not in qe.fault_summary, qe.fault_summary
+    assert qe.last_metrics.get("mesh_fallback") is None
+    # the restart RESUMED: the replay is bounded by the checkpoint
+    # cadence, never a restart-from-chunk-0
+    assert 0 < _replayed(tpch_session) - before <= 2
+    assert _restarts(tpch_session) - restarts0 == 1
+    restore = next(ev for ev in qe.fault_events
+                   if ev["action"] == "checkpoint_restore")
+    assert restore["cursor"] == 2 and restore["driver"] == "mesh"
+    assert restore["chunks_replayed"] <= 2
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_gang_restart_without_checkpoint_restarts_stream(
+        tpch_session, tpch_path, streaming_conf):
+    """checkpoint disabled: the gang restart still keeps the query on
+    the mesh — the stream just restarts from chunk 0."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(CKPT_KEY, 0)
+    with faults.inject(streaming_conf, "mesh:fatal:1") as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert plan.fired_log == [("mesh", 1, "fatal")]
+    assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+    assert "mesh_fallback" not in qe.fault_summary
+    assert "checkpoint_restore" not in qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_gang_restart_non_streamed_plan(tpch_session, tpch_path,
+                                        streaming_conf):
+    """Q3 (joins/exchanges — not a mesh-streamable aggregate): a
+    compile-time mesh fault still restarts the gang instead of
+    degrading, with parity."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    with faults.inject(streaming_conf, "mesh:fatal:1") as plan:
+        got, qe = _run_query(tpch_session, "q3")
+        assert plan.fired_log == [("mesh", 1, "fatal")]
+    assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+    assert "mesh_fallback" not in qe.fault_summary
+    _check_golden(got, tpch_path, "q3")
+
+
+def test_restart_budget_exhaustion_lands_single_device(
+        tpch_session, tpch_path, streaming_conf):
+    """`mesh_restart:fatal` kills the only restart attempt: the ladder
+    must still land on the single-device rung (resuming from the
+    checkpoint) and reach parity — restarts degrade gracefully, they
+    never remove the final rung."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(CKPT_KEY, 2)
+    streaming_conf.set(RESTART_MAX_KEY, 1)
+    spec = "mesh_checkpoint:fatal:2,mesh_restart:fatal:1"
+    with faults.inject(streaming_conf, spec) as plan:
+        got, qe = _run_query(tpch_session, "q1")
+        assert ("mesh_restart", 1, "fatal") in plan.fired_log
+    assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+    assert qe.fault_summary.get("mesh_fallback") == 1, qe.fault_summary
+    assert qe.last_metrics.get("mesh_fallback") == 1
+    # the failed attempt carries its error in the event record
+    failed = next(ev for ev in qe.fault_events
+                  if ev["action"] == "mesh_restart")
+    assert failed.get("ok") is False and "INTERNAL" in failed["error"]
+    # the single-device rung still restored from the checkpoint
+    assert qe.fault_summary.get("checkpoint_restore") == 1
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_restarts_disabled_preserves_fallback(tpch_session, tpch_path,
+                                              streaming_conf):
+    """meshRestart.enabled=false restores the PR-5 ladder: straight to
+    single-device, no restart attempted."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(RESTART_KEY, False)
+    with faults.inject(streaming_conf, "mesh:fatal:1"):
+        got, qe = _run_query(tpch_session, "q1")
+    assert "mesh_restart" not in qe.fault_summary, qe.fault_summary
+    assert qe.fault_summary.get("mesh_fallback") == 1
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_restart_runs_even_with_fallback_disabled(tpch_session,
+                                                  tpch_path,
+                                                  streaming_conf):
+    """Each ladder rung has its own conf: meshFallback.enabled=false
+    (mesh-or-fail — no degraded single-device mode) must NOT disable
+    gang restarts; a transient mesh loss still heals on the mesh."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set("spark_tpu.execution.meshFallback.enabled", False)
+    with faults.inject(streaming_conf, "mesh:fatal:1"):
+        got, qe = _run_query(tpch_session, "q1")
+    assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+    assert "mesh_fallback" not in qe.fault_summary
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_restart_skipped_when_pool_collapsed(tpch_session, tpch_path,
+                                             streaming_conf,
+                                             monkeypatch):
+    """A healthy pool of <= 1 devices cannot host a gang: the restart
+    rung is skipped (no budget burned, no doomed re-mesh) and the
+    ladder goes straight to the single-device rung."""
+    from spark_tpu.parallel import elastic as EL
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    monkeypatch.setattr(EL, "healthy_device_count", lambda conf: 1)
+    with faults.inject(streaming_conf, "mesh:fatal:1"):
+        got, qe = _run_query(tpch_session, "q1")
+    assert "mesh_restart" not in qe.fault_summary, qe.fault_summary
+    assert qe.fault_summary.get("mesh_fallback") == 1
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_stale_decommission_request_discarded(tpch_session,
+                                              streaming_conf):
+    """A drain request with no position valid for the gang must be
+    discarded at the next mesh query (with a warning), never left
+    armed to fire on a future larger mesh."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    tpch_session.decommission_shards([9])  # 8-gang: position invalid
+    with pytest.warns(UserWarning, match="stale decommission"):
+        qe, pdf = _mesh_stream_qe(tpch_session, name="stale_t")
+        b, _, _ = qe.execute_batch()
+    assert "decommission" not in qe.fault_summary, qe.fault_summary
+    assert streaming_conf.get(DRAIN_KEY) == ""  # consumed, not armed
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+
+
+def test_decommission_requests_merge(tpch_session, streaming_conf):
+    """Back-to-back drain requests merge — the second must not
+    silently drop a still-pending first."""
+    tpch_session.decommission_shards([1])
+    tpch_session.decommission_shards([2])
+    assert streaming_conf.get(DRAIN_KEY) == "1,2"
+    streaming_conf.set(DRAIN_KEY, "")
+
+
+def test_unparseable_decommission_request_discarded(tpch_session,
+                                                    streaming_conf):
+    """A spec with no parseable entry is discarded at the next mesh
+    query (it could never fire, and left armed it would warn at every
+    chunk boundary forever)."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(DRAIN_KEY, "x3")
+    with pytest.warns(UserWarning, match="unparseable decommission"):
+        qe, pdf = _mesh_stream_qe(tpch_session, name="unparse_t")
+        b, _, _ = qe.execute_batch()
+    assert streaming_conf.get(DRAIN_KEY) == ""
+    assert "decommission" not in qe.fault_summary
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+
+
+def test_exclusions_do_not_mask_misconfiguration(tpch_session,
+                                                 streaming_conf):
+    """An exclusion must not swallow the mesh.size-vs-devices setup
+    diagnostic: a pool short even BEFORE exclusions still raises."""
+    from spark_tpu.parallel.mesh import get_mesh
+    streaming_conf.set(MESH_KEY, 64)  # more than the 8 virtual devices
+    streaming_conf.set(EXCLUDE_KEY, "3")
+    with pytest.raises(RuntimeError, match="devices visible"):
+        get_mesh(streaming_conf)
+    streaming_conf.set(EXCLUDE_KEY, "")
+
+
+def test_mesh_fallback_not_sticky_across_executions(tpch_session,
+                                                    streaming_conf):
+    """Satellite regression: a fallback used to pin the QueryExecution
+    single-device FOREVER (the _exec_conf overlay and _mesh_fallback
+    flag survived execute_batch re-entry). A later execution of the
+    same qe with a healed mesh must run on the mesh again."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(RESTART_KEY, False)
+    qe, pdf = _mesh_stream_qe(tpch_session, name="sticky_t")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject(streaming_conf, "mesh:fatal:1") as plan:
+            b, _, _ = qe.execute_batch()
+            assert plan.fired_log, "mesh fault never fired — vacuous"
+    assert qe.fault_summary.get("mesh_fallback") == 1
+    assert qe.last_metrics.get("mesh_fallback") == 1
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+    # the mesh healed (no faults): the SAME qe re-executes on the mesh
+    b2, _, _ = qe.execute_batch()
+    assert qe.fault_summary == {}, qe.fault_summary
+    assert qe.last_metrics.get("mesh_fallback") is None, qe.last_metrics
+    _groupsum_parity(b2.to_arrow().to_pandas(), pdf)
+
+
+# -- graceful decommission ---------------------------------------------------
+
+def test_decommission_drains_at_chunk_boundary(tpch_session,
+                                               streaming_conf):
+    """A drain requested mid-stream applies at the next chunk boundary:
+    checkpoint forced at the cursor, `decommission` recorded, the
+    shard's device excluded at session level, and the query continues
+    on the 7-gang from the checkpoint — with parity."""
+    _cold(tpch_session)
+    conf = streaming_conf
+    conf.set(MESH_KEY, 8)
+    conf.set(CKPT_KEY, 2)
+    conf.set(SPANS_KEY, "on")
+
+    class Drainer(QueryListener):
+        done = False
+
+        def on_shard_records(self, e):
+            if not self.done and e.chunk >= 1:
+                self.done = True
+                tpch_session.decommission_shards([3])
+
+    drainer = Drainer()
+    tpch_session.add_listener(drainer)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qe, pdf = _mesh_stream_qe(tpch_session, name="drain_t")
+            b, _, _ = qe.execute_batch()
+    finally:
+        tpch_session.remove_listener(drainer)
+    assert drainer.done, "drain request never posted — vacuous"
+    assert qe.fault_summary.get("decommission") == 1, qe.fault_summary
+    # session-level exclusion persisted, one-shot request consumed,
+    # and the plan-facing gang size follows the surviving pool
+    assert conf.get(EXCLUDE_KEY) != ""
+    assert conf.get(DRAIN_KEY) == ""
+    assert int(conf.get(MESH_KEY)) == 7
+    # the drain forced a checkpoint: the reduced gang RESUMED, and the
+    # post-drain chunks ran on 7 shards
+    assert qe.fault_summary.get("checkpoint_restore") == 1
+    comp = [r for r in qe.spans.shard_records if r["phase"] == "compute"]
+    shards_by_chunk = {}
+    for r in comp:
+        shards_by_chunk.setdefault(r["chunk"], set()).add(r["shard"])
+    assert max(len(s) for s in shards_by_chunk.values()) == 8
+    assert len(shards_by_chunk[max(shards_by_chunk)]) == 7
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+
+
+def test_decommission_before_first_chunk(tpch_session, tpch_path,
+                                         streaming_conf):
+    """A drain requested before the stream starts applies at the FIRST
+    boundary: no checkpoint to force (cursor 0), the whole stream runs
+    on the reduced gang, golden parity holds."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    streaming_conf.set(SPANS_KEY, "on")
+    tpch_session.decommission_shards([7])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got, qe = _run_query(tpch_session, "q1")
+    assert qe.fault_summary.get("decommission") == 1, qe.fault_summary
+    assert "checkpoint_restore" not in qe.fault_summary
+    comp = [r for r in qe.spans.shard_records if r["phase"] == "compute"]
+    assert comp and {r["shard"] for r in comp} == set(range(7))
+    _check_golden(got, tpch_path, "q1")
+
+
+def test_decommission_seam_fault_rides_mesh_ladder(tpch_session,
+                                                   streaming_conf):
+    """A fatal at the `decommission` seam (the drain machinery dying at
+    its boundary) is a mesh failure: gang restart keeps the query on
+    the mesh, and the drain applies at the restarted stream's first
+    boundary."""
+    _cold(tpch_session)
+    streaming_conf.set(MESH_KEY, 8)
+    tpch_session.decommission_shards([2])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject(streaming_conf, "decommission:fatal:1") as plan:
+            qe, pdf = _mesh_stream_qe(tpch_session, name="drainfault_t")
+            b, _, _ = qe.execute_batch()
+            assert plan.fired_log == [("decommission", 1, "fatal")]
+    assert qe.fault_summary.get("mesh_restart") == 1, qe.fault_summary
+    assert qe.fault_summary.get("decommission") == 1, qe.fault_summary
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+
+
+def test_pending_decommission_parsing(tpch_session, streaming_conf):
+    from spark_tpu.parallel.elastic import pending_decommission
+    from spark_tpu.parallel.mesh import get_mesh
+    streaming_conf.set(MESH_KEY, 8)
+    mesh = get_mesh(streaming_conf)
+    streaming_conf.set(DRAIN_KEY, "")
+    assert pending_decommission(streaming_conf, mesh) == ((), ())
+    streaming_conf.set(DRAIN_KEY, "3,5")
+    pos, ids = pending_decommission(streaming_conf, mesh)
+    assert pos == (3, 5) and len(ids) == 2
+    # positions outside the current gang are ignored (an
+    # already-drained position must not re-fire forever)
+    streaming_conf.set(DRAIN_KEY, "64")
+    assert pending_decommission(streaming_conf, mesh) == ((), ())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        streaming_conf.set(DRAIN_KEY, "junk,2")
+        pos, _ = pending_decommission(streaming_conf, mesh)
+    assert pos == (2,)
+    streaming_conf.set(DRAIN_KEY, "")
+
+
+def test_get_mesh_exclusions_shrink(tpch_session, streaming_conf):
+    """Exclusions shrink the gang to the surviving pool instead of
+    raising; <= 1 survivor degrades to single-chip (None)."""
+    import jax
+    from spark_tpu.parallel.mesh import get_mesh
+    streaming_conf.set(MESH_KEY, 8)
+    ids = [int(d.id) for d in jax.devices()]
+    streaming_conf.set(EXCLUDE_KEY, str(ids[0]))
+    mesh = get_mesh(streaming_conf)
+    assert int(mesh.devices.size) == 7
+    assert ids[0] not in [int(d.id) for d in mesh.devices.flat]
+    streaming_conf.set(EXCLUDE_KEY, ",".join(str(i) for i in ids[:7]))
+    assert get_mesh(streaming_conf) is None
+    streaming_conf.set(EXCLUDE_KEY, "")
+
+
+# -- straggler rebalancing ---------------------------------------------------
+
+def _slow_shard_rules(shard, chunks, n=8, ms=60):
+    return ",".join(f"shard_chunk:slow:{c * n + shard + 1}:{ms}"
+                    for c in range(chunks))
+
+
+def test_rebalance_shifts_rows_off_flagged_shard(tpch_session,
+                                                 streaming_conf,
+                                                 tmp_path):
+    """The detect->act loop: a chaos-slowed shard 5 gets flagged by the
+    StragglerMonitor mid-stream and subsequent chunks assign it HALF
+    its fair share (maxSkew 0.5) — proven via shard_summary() row
+    deltas from the event log, with parity and the `shard_rebalance`
+    action + `rebalance_rows` counter observable."""
+    from spark_tpu import history
+    _cold(tpch_session)
+    conf = streaming_conf
+    log_dir = str(tmp_path / "ev")
+    conf.set("spark_tpu.sql.eventLog.dir", log_dir)
+    conf.set(MESH_KEY, 8)
+    conf.set(SPANS_KEY, "on")
+    conf.set("spark_tpu.sql.straggler.minChunks", 3)
+    conf.set("spark_tpu.sql.straggler.factor", 4.0)
+    rb0 = tpch_session.metrics.counter("rebalance_rows").value
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject(conf, _slow_shard_rules(5, 6)) as plan:
+                qe, pdf = _mesh_stream_qe(tpch_session, name="rebal_t")
+                b, _, _ = qe.execute_batch()
+        assert plan.fired_log, "shard_chunk seam never fired — vacuous"
+    finally:
+        conf.set("spark_tpu.sql.eventLog.dir", "")
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+    assert qe.fault_summary.get("shard_rebalance") == 1, qe.fault_summary
+    moved = tpch_session.metrics.counter("rebalance_rows").value - rb0
+    assert moved > 0
+    # shard_summary row deltas: 16000 rows / 1024-chunks / 8 shards =
+    # 128 fair rows per full chunk; post-flag shard 5 holds <= 64
+    shards = history.shard_summary(history.read_event_log(log_dir))
+    mine = shards[(shards["query_id"] == qe.query_id)
+                  & (shards["phase"] == "compute")]
+    s5 = mine[mine["shard"] == 5].set_index("chunk")["rows"]
+    assert s5.iloc[0] == 128  # even split before detection
+    assert s5.min() <= 64, s5  # skewed away after the flag
+    # the deficit moved ONTO healthy shards, not out of the query
+    last_chunk = mine[mine["chunk"] == int(s5.index.max())]
+    assert int(last_chunk["rows"].sum()) > 0
+    assert int(mine["rows"].sum()) == len(pdf)
+
+
+def test_rebalance_disabled_keeps_even_assignment(tpch_session,
+                                                  streaming_conf):
+    """rebalance.enabled=false: the straggler still flags (detection
+    untouched) but assignment stays even and nothing is recorded."""
+    _cold(tpch_session)
+    conf = streaming_conf
+    conf.set(MESH_KEY, 8)
+    conf.set(SPANS_KEY, "on")
+    conf.set(REBALANCE_KEY, False)
+    conf.set("spark_tpu.sql.straggler.minChunks", 3)
+    conf.set("spark_tpu.sql.straggler.factor", 4.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faults.inject(conf, _slow_shard_rules(5, 6)):
+            qe, pdf = _mesh_stream_qe(tpch_session, name="rebal_off_t")
+            b, _, _ = qe.execute_batch()
+    assert "shard_rebalance" not in qe.fault_summary, qe.fault_summary
+    comp = [r for r in qe.spans.shard_records
+            if r["phase"] == "compute" and r["shard"] == 5]
+    full = [r["rows"] for r in comp if r["chunk"] < 15]
+    assert full and all(r == 128 for r in full), full
+    _groupsum_parity(b.to_arrow().to_pandas(), pdf)
+
+
+def test_rebalance_state_math():
+    """Assignment invariants: targets sum to the live count, the slow
+    shard's share drops by maxSkew, slot capacity bounds every target,
+    and flagging is bounded/idempotent."""
+    from spark_tpu.config import Conf
+    from spark_tpu.parallel.elastic import RebalanceState
+    conf = Conf()
+    st = RebalanceState(8, conf)
+    assert not st.active
+    even = st.targets(1024)
+    assert even.sum() == 1024 and set(even) == {128}
+    st.flag(5)
+    assert st.active and st.slow == {5}
+    st.flag(5)  # idempotent
+    assert st.slow == {5}
+    t = st.targets(1024)
+    assert t.sum() == 1024
+    assert t[5] == 64  # (1 - 0.5) x fair
+    s_cap = st.slot_capacity(1024)
+    assert all(int(x) <= s_cap for x in t)
+    # odd live counts still sum exactly (largest-remainder rounding)
+    t2 = st.targets(1000)
+    assert t2.sum() == 1000
+    # can never flag the whole gang: someone must absorb the rows
+    for s in range(8):
+        st.flag(s)
+    assert len(st.slow) == 7
+
+
+def test_rebalance_batch_preserves_rows():
+    """pad_chunk_for_shards with an active state moves rows between
+    shard segments but never loses or duplicates a live row."""
+    import jax
+    from spark_tpu.columnar import Batch
+    from spark_tpu.config import Conf
+    from spark_tpu.parallel.elastic import (RebalanceState,
+                                            pad_chunk_for_shards)
+    st = RebalanceState(4, Conf())
+    st.flag(1)
+    vals = np.arange(100, dtype=np.int64)
+    b = Batch.from_numpy({"v": vals})
+    out = pad_chunk_for_shards(b, 4, st)
+    assert out.capacity % 4 == 0
+    mask = np.asarray(jax.device_get(out.selection_mask()))
+    data = np.asarray(jax.device_get(out.columns["v"].data))
+    live = sorted(data[mask].tolist())
+    assert live == vals.tolist()
+    s_cap = out.capacity // 4
+    seg1 = mask[1 * s_cap:2 * s_cap].sum()
+    seg_others = [mask[i * s_cap:(i + 1) * s_cap].sum()
+                  for i in (0, 2, 3)]
+    assert seg1 < min(seg_others)
+
+
+# -- observability -----------------------------------------------------------
+
+def test_elastic_actions_reach_history(tpch_session, streaming_conf,
+                                       tmp_path):
+    """mesh_restart flows through fault_summary into the event log and
+    history.fault_summary's action columns."""
+    from spark_tpu import history
+    _cold(tpch_session)
+    conf = streaming_conf
+    log_dir = str(tmp_path / "ev")
+    conf.set("spark_tpu.sql.eventLog.dir", log_dir)
+    conf.set(MESH_KEY, 8)
+    conf.set(CKPT_KEY, 2)
+    try:
+        with faults.inject(conf, "mesh_checkpoint:fatal:2"):
+            _run_query(tpch_session, "q1")
+    finally:
+        conf.set("spark_tpu.sql.eventLog.dir", "")
+    summary = history.fault_summary(history.read_event_log(log_dir))
+    assert len(summary) >= 1
+    row = summary.iloc[-1]
+    assert row["mesh_restart"] == 1
+    assert row["mesh_fallback"] == 0
+    assert row["checkpoint_restore"] == 1
+    assert any(ev.get("action") == "mesh_restart" for ev in row["events"])

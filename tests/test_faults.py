@@ -222,8 +222,12 @@ def test_stage_timeout_retry_parity(tpch_session, tpch_path):
 def test_mesh_failure_falls_back_single_device(tpch_session, tpch_path,
                                                qname):
     """A fault in the mesh/shard_map path re-plans single-device: the
-    degraded run must still hit golden parity and flag mesh_fallback."""
+    degraded run must still hit golden parity and flag mesh_fallback.
+    Gang restart (the elastic rung that would now win first — see
+    tests/test_elastic.py) is disabled to pin the fallback rung."""
     _cold(tpch_session)
+    tpch_session.conf.set("spark_tpu.execution.meshRestart.enabled",
+                          False)
     tpch_session.conf.set(MESH_KEY, 8)
     try:
         with faults.inject(tpch_session.conf, "mesh:fatal:1") as plan:
@@ -252,9 +256,13 @@ def test_mesh_misconfiguration_surfaces(tpch_session):
 
 
 def test_mesh_fallback_disabled_surfaces(tpch_session):
+    """With BOTH elastic rungs off (no restart, no degrade), a fatal
+    mesh failure surfaces unchanged. meshFallback.enabled=false alone
+    no longer disables gang restarts — each rung has its own conf."""
     _cold(tpch_session)
     conf = tpch_session.conf
     conf.set(MESH_KEY, 8)
+    conf.set("spark_tpu.execution.meshRestart.enabled", False)
     conf.set("spark_tpu.execution.meshFallback.enabled", False)
     try:
         with faults.inject(conf, "mesh:fatal:1"):
