@@ -592,6 +592,90 @@ def measure_obs_overhead(spark, run, base_dir: str, best_of: int = 3
             "obs_on_ms": round(on_s * 1e3, 1)}
 
 
+def bench_streaming(spark):
+    """Durable-streaming section: a file-source stateful stream where
+    ~6% of a 4096-group domain changes per trigger — the shape the
+    incremental state store (execution/state_store.py) exists for.
+    Sidecars: `streaming_rows_per_s` (micro-batch throughput incl.
+    per-trigger delta persistence), `streaming_state_delta_bytes`
+    (steady-state delta size) vs `streaming_state_snapshot_bytes`
+    (the full-state write it replaces — the ratio is the incremental
+    win), and `streaming_restore_ms` (fresh-query recovery =
+    newest snapshot + <= snapshotEveryDeltas delta replays)."""
+    import tempfile
+
+    import pandas as pd
+
+    from spark_tpu import functions as F
+    from spark_tpu.functions import col
+
+    base = tempfile.mkdtemp(prefix="bench_stream_")
+    src_dir = os.path.join(base, "src")
+    os.makedirs(src_dir)
+    ck = os.path.join(base, "ck")
+    domain = 4096
+    batch_rows = 1 << 16
+    n_batches = 12
+    schema = pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                           "v": pd.Series([], dtype=np.int64)})
+    records = []
+
+    class _Cap:
+        def on_streaming_batch(self, event):
+            records.append(event.record)
+
+    cap = _Cap()
+    spark.add_listener(cap)
+    try:
+        def build():
+            src = spark.file_stream(src_dir, schema_df=schema)
+            return (src.to_df()
+                    .group_by(F.pmod(col("k"), domain).alias("g"))
+                    .agg(F.sum(col("v")).alias("s"),
+                         F.count().alias("c"))
+                    .write_stream(ck))
+
+        q = build()
+        rng = np.random.RandomState(11)
+        total_rows = 0
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            if i == 0:
+                k = np.arange(batch_rows, dtype=np.int64)  # all groups
+            else:
+                # ~6% of groups churn per trigger
+                hot = rng.choice(domain, domain // 16, replace=False)
+                k = hot[rng.randint(0, len(hot), batch_rows)] \
+                    .astype(np.int64)
+            pd.DataFrame({"k": k, "v": np.ones(batch_rows, np.int64)}) \
+                .to_parquet(os.path.join(src_dir, f"b{i:04d}.parquet"))
+            q.process_available()
+            total_rows += batch_rows
+        elapsed = time.perf_counter() - t0
+        # fresh-query recovery wall-clock (snapshot + delta replays)
+        r0 = spark.metrics.counter("streaming_restore_ms").value
+        q2 = build()
+        restore_ms = spark.metrics.counter(
+            "streaming_restore_ms").value - r0
+        replayed = q2._store.last_restore_replayed
+    finally:
+        spark.remove_listener(cap)
+    snaps = [r["state_bytes"] for r in records
+             if r["kind"] == "snapshot"]
+    deltas = [r["state_bytes"] for r in records if r["kind"] == "delta"]
+    out = {"streaming_rows_per_s": round(total_rows / elapsed, 1),
+           "streaming_batches": len(records),
+           "streaming_restore_ms": round(restore_ms, 1),
+           "streaming_restore_replayed_deltas": int(replayed)}
+    if snaps and deltas:
+        out["streaming_state_snapshot_bytes"] = int(max(snaps))
+        out["streaming_state_delta_bytes"] = int(
+            sum(deltas) / len(deltas))
+        out["streaming_delta_ratio"] = round(
+            out["streaming_state_delta_bytes"] / max(snaps), 4)
+    return out
+
+
 def bench_obs_overhead(spark):
     """Observability tax on the wall-clock (satellite of the flight
     -recorder PR): TPC-H Q1 at a small SF, warm, best-of-3, with ALL
@@ -683,6 +767,12 @@ def main():
     emit_summary()
     extra.update(run_budgeted(
         "obs_overhead", lambda: bench_obs_overhead(spark),
+        min(budget, 240)))
+    emit_summary()
+    # durable streaming: micro-batch throughput + incremental
+    # state-store delta-vs-snapshot bytes + fresh-query restore cost
+    extra.update(run_budgeted(
+        "streaming", lambda: bench_streaming(spark),
         min(budget, 240)))
     emit_summary()
     # the TPC-H trajectory is the headline consumer of BENCH rounds:

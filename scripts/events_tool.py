@@ -8,11 +8,12 @@ enforceable in CI:
 
     scripts/events_tool.py validate <file-or-dir> [...]
         Validate every app-*.jsonl line against the versioned schema.
-        Knows every published schema_version (1..3): v3 added the
-        per-shard `shards` records, `plan_tree` and `predictions` —
-        purely additive, so old logs must (and do) validate under
-        their own version's rules. Exits nonzero listing
-        file:line: problem for every violation.
+        Knows every published schema_version (1..4): v3 added the
+        per-shard `shards` records, `plan_tree` and `predictions`;
+        v4 added the per-micro-batch `streaming` record — purely
+        additive, so old logs must (and do) validate under their own
+        version's rules. Exits nonzero listing file:line: problem for
+        every violation.
 
     scripts/events_tool.py tail <file-or-dir> [-n N]
         Pretty-print the last N events (default 10): query id, status,
@@ -30,7 +31,24 @@ import json
 import os
 import sys
 
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3)
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4)
+
+#: per-micro-batch streaming record contract (schema v4):
+#: field -> allowed types
+_STREAMING_FIELDS = {
+    "batch_id": (int,),
+    "start": (int,),
+    "end": (int,),
+    "rows_in": (int,),
+    "rows_out": (int,),
+    "kind": (str,),
+    "state_bytes": (int, type(None)),
+    "quarantined": (int,),
+    "sink_parts": (int,),
+    "source": (str,),
+}
+
+_STREAMING_KINDS = ("stateless", "delta", "snapshot")
 
 #: per-shard record contract (schema v3): field -> allowed types
 #: (shard None marks host-side ingest records)
@@ -93,6 +111,10 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
                 _problem(out, path, lineno,
                          f"schema v{ver} record carries v3 field "
                          f"{v3_field!r}")
+    if ver < 4 and "streaming" in e:
+        _problem(out, path, lineno,
+                 f"schema v{ver} record carries v4 field 'streaming'")
+    if ver < 3:
         return
     reorder = e.get("reorder")
     if reorder is not None and (
@@ -128,6 +150,23 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
             _problem(out, path, lineno,
                      f"malformed prediction record: {p!r}")
             break
+    if ver >= 4:
+        s = e.get("streaming")
+        if s is not None:
+            bad = None
+            if not isinstance(s, dict):
+                bad = "not a dict"
+            else:
+                for field, types in _STREAMING_FIELDS.items():
+                    if not isinstance(s.get(field), types):
+                        bad = f"field {field!r} not {types}"
+                        break
+                if bad is None and s.get("kind") not in _STREAMING_KINDS:
+                    bad = (f"kind {s.get('kind')!r} not in "
+                           f"{_STREAMING_KINDS}")
+            if bad is not None:
+                _problem(out, path, lineno,
+                         f"malformed streaming record ({bad}): {s!r}")
 
 
 def _log_files(targets):

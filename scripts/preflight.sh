@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Ten stages, all mandatory:
+# Eleven stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -55,6 +55,12 @@
 #      single-device fallback), resume from the last checkpoint with
 #      at most checkpoint.everyChunks chunks replayed, and hit TPC-H
 #      Q1 golden parity
+#  11. streaming durability smoke: a file-source stateful streaming
+#      query crashed at the stream_state_commit seam, the query object
+#      discarded, and a FRESH StreamingQuery over the same checkpoint
+#      must recover to output byte-identical to an uninterrupted run
+#      (incremental state store: delta restore), with the
+#      streaming_batches metric and per-batch event records sane
 #
 # Usage: scripts/preflight.sh [--fast]
 #   --fast skips the full pytest suite (stages 2-10 still run) for
@@ -70,7 +76,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/10: tier-1 test suite --"
+    echo "-- stage 1/11: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -84,16 +90,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/10: SKIPPED (--fast) --"
+    echo "-- stage 1/11: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/10: dryrun_multichip(8) --"
+echo "-- stage 2/11: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/10: bench smoke --"
+echo "-- stage 3/11: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -125,7 +131,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/10: chaos smoke --"
+echo "-- stage 4/11: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -179,7 +185,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/10: observability + analysis smoke --"
+echo "-- stage 5/11: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -272,10 +278,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/10: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/11: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/10: SQL service smoke --"
+echo "-- stage 7/11: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -349,7 +355,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/10: join-kernel + ingest parity smoke --"
+echo "-- stage 8/11: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -407,7 +413,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/10: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/11: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -451,7 +457,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/10: elastic mesh smoke --"
+echo "-- stage 10/11: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -500,5 +506,98 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "replayed_chunks": int(replayed),
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
+
+echo "-- stage 11/11: streaming durability smoke --"
+# File source -> stateful query -> crash at the state-commit seam ->
+# query object discarded -> fresh query over the same checkpoint must
+# recover exactly-once (output byte-identical to an uninterrupted run)
+# with the streaming_* metrics and v4 event records sane.
+env JAX_PLATFORMS=cpu python - <<'EOF7'
+import json
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_tpu import SparkTpuSession, history
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+from spark_tpu.testing import faults
+
+spark = SparkTpuSession.builder().get_or_create()
+base = tempfile.mkdtemp(prefix="preflight_stream_")
+spark.conf.set("spark_tpu.sql.eventLog.dir", base + "/events")
+schema = pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                       "v": pd.Series([], dtype=np.int64)})
+
+
+def setup(tag):
+    src_dir = os.path.join(base, f"src_{tag}")
+    os.makedirs(src_dir, exist_ok=True)
+
+    def feed(i):
+        # batch 0 touches every group (snapshot); later batches touch
+        # 16 of 64 (deltas — the incremental-store steady state)
+        n = 256 if i == 0 else 16
+        pd.DataFrame({"k": np.arange(n, dtype=np.int64),
+                      "v": np.full(n, i + 1, dtype=np.int64)}) \
+            .to_parquet(os.path.join(src_dir, f"b{i:03d}.parquet"))
+
+    def build():
+        src = spark.file_stream(src_dir, schema_df=schema)
+        return (src.to_df()
+                .group_by(F.pmod(col("k"), 64).alias("g"))
+                .agg(F.sum(col("v")).alias("s"), F.count().alias("c"))
+                .write_stream(os.path.join(base, f"ck_{tag}")))
+
+    return feed, build
+
+
+# uninterrupted twin
+feed_u, build_u = setup("clean")
+qu = build_u()
+for i in range(3):
+    feed_u(i)
+    qu.process_available()
+want = qu.latest().sort_values("g").reset_index(drop=True)
+
+# crashed run: batch 0 commits, batch 1 dies AT the state commit
+b0 = spark.metrics.counter("streaming_batches").value
+feed_c, build_c = setup("crash")
+q = build_c()
+feed_c(0)
+q.process_available()
+feed_c(1)
+crashed = False
+with faults.inject(spark.conf, "stream_state_commit:fatal:1") as fp:
+    try:
+        q.process_available()
+    except faults.FaultInjected:
+        crashed = True
+assert crashed and fp.fired_log, "stream_state_commit never fired — smoke is vacuous"
+del q  # the hard crash: only the checkpoint dir survives
+feed_c(2)
+q2 = build_c()
+q2.process_available()
+got = q2.latest().sort_values("g").reset_index(drop=True)
+pd.testing.assert_frame_equal(got, want)
+batches = spark.metrics.counter("streaming_batches").value - b0
+assert batches == 3, batches  # batch 0 + replayed batch 1 + batch 2
+events = history.read_event_log(base + "/events")
+ss = history.streaming_summary(events)
+# 3 clean-run + 3 crash-run committed batches, snapshot at each v0
+assert len(ss) == 6 and set(ss["kind"]) == {"snapshot", "delta"}, ss
+spark.conf.set("spark_tpu.sql.eventLog.dir", "")
+with open("/tmp/_preflight_stream_dir", "w") as f:
+    f.write(base + "/events")
+print(json.dumps({"preflight_streaming_smoke": "ok",
+                  "batches": int(batches),
+                  "kinds": ss["kind"].tolist()}))
+EOF7
+
+# the streaming event lines validate against the versioned schema
+env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
+    "$(cat /tmp/_preflight_stream_dir)"
 
 echo "== preflight PASSED =="

@@ -758,6 +758,38 @@ SERVICE_HISTORY_SIZE = register(
         "separate (smaller) bound; oldest entries drop past it.",
     validator=lambda v: v >= 1)
 
+STREAMING_SNAPSHOT_EVERY = register(
+    "spark_tpu.streaming.stateStore.snapshotEveryDeltas", 10,
+    doc="Incremental streaming state store "
+        "(execution/state_store.py): write a FULL state snapshot "
+        "every N versions; the versions between persist as deltas "
+        "(only the groups whose accumulators changed that batch). "
+        "Restore = newest snapshot <= the committed version + replay "
+        "of at most N-1 deltas. 1 snapshots every version (the "
+        "pre-incremental behavior).",
+    validator=lambda v: v >= 1)
+
+STREAMING_RETAIN = register(
+    "spark_tpu.streaming.retainBatches", 2,
+    doc="Streaming checkpoint retention window (the "
+        "minBatchesToRetain seat): offset/commit log entries and "
+        "state files needed only by versions older than "
+        "committed - retain are compacted away. Recovery reads only "
+        "the last committed version; the window exists so a torn "
+        "newest log entry can fall back one version.",
+    validator=lambda v: v >= 1)
+
+STREAMING_FILE_STRICT = register(
+    "spark_tpu.streaming.source.file.strict", False,
+    doc="File stream source corrupt-file policy: by default a file "
+        "that fails to decode (torn write, wrong schema, not the "
+        "source's format) is QUARANTINED — marked in the source's "
+        "seen-file log, counted in streaming_files_quarantined, "
+        "skipped by the batch and by every replay — so one bad file "
+        "cannot wedge the stream. true fails the batch instead "
+        "(at-least-once delivery of every file byte wins over "
+        "availability).")
+
 MESH_SIZE = register(
     "spark_tpu.sql.mesh.size", 0,
     doc="Number of devices on the data axis of the SPMD mesh. 0 or 1 "

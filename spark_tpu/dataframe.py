@@ -274,10 +274,15 @@ class DataFrame:
         return self
 
     def write_stream(self, checkpoint_dir: str,
-                     output_mode: str = "complete"):
+                     output_mode: str = "complete",
+                     sink_path: str = None):
         """Start a micro-batch streaming query over this plan (the plan
         must contain one streaming source; reference:
-        DataStreamWriter.start -> MicroBatchExecution)."""
+        DataStreamWriter.start -> MicroBatchExecution). `sink_path`
+        adds a FileStreamSink: per-batch parquet parts committed by an
+        atomic `_metadata` manifest (read back with
+        spark_tpu.streaming.read_sink), exactly-once under
+        crash-replay."""
         from .streaming import StreamingQuery, _StreamSource
         streams = []
 
@@ -293,7 +298,8 @@ class DataFrame:
                 f"write_stream needs exactly one streaming source "
                 f"(found {len(streams)})")
         return StreamingQuery(self.session, self.plan, streams[0],
-                              checkpoint_dir, output_mode)
+                              checkpoint_dir, output_mode,
+                              sink_path=sink_path)
 
     writeStream = write_stream
 

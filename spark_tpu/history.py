@@ -41,7 +41,7 @@ def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
 
 #: event fields kept nested (object columns) rather than flattened
 _NESTED = ("spans", "stages", "shards", "predictions",
-           "analysis_findings", "plan_tree", "reorder")
+           "analysis_findings", "plan_tree", "reorder", "streaming")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
@@ -206,6 +206,38 @@ def hbm_summary(events: pd.DataFrame) -> pd.DataFrame:
                      "capacity_bytes": cap,
                      "headroom_ratio": (round(peak / cap, 4)
                                         if cap else None)})
+    return pd.DataFrame(rows)
+
+
+def streaming_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Per-micro-batch lifecycle from a read_event_log frame: one row
+    per `streaming` record (schema v4) — batch id, offset range, rows
+    in/out, state persistence kind (delta vs snapshot) and bytes,
+    changed groups, quarantined files, sink parts and wall time. The
+    replay surface of the durable-streaming tier (streaming.py +
+    execution/state_store.py); the incremental-checkpointing claim
+    (steady-state delta bytes << snapshot bytes) is checkable straight
+    off this frame."""
+    rows: List[dict] = []
+    if "streaming" not in events.columns:
+        return pd.DataFrame(rows)
+    for _, r in events.iterrows():
+        s = r.get("streaming")
+        if not isinstance(s, dict):
+            continue
+        rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                     "query_id": r.get("query_id"),
+                     "batch_id": s.get("batch_id"),
+                     "start": s.get("start"), "end": s.get("end"),
+                     "rows_in": s.get("rows_in"),
+                     "rows_out": s.get("rows_out"),
+                     "kind": s.get("kind"),
+                     "state_bytes": s.get("state_bytes"),
+                     "changed_groups": s.get("changed_groups"),
+                     "quarantined": s.get("quarantined"),
+                     "sink_parts": s.get("sink_parts"),
+                     "source": s.get("source"),
+                     "wall_ms": s.get("wall_ms")})
     return pd.DataFrame(rows)
 
 

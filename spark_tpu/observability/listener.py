@@ -125,6 +125,21 @@ class StragglerEvent:
 
 
 @dataclass
+class StreamingBatchEvent:
+    """Posted by the micro-batch loop (streaming.py) once per COMMITTED
+    batch: `record` is the event-log `streaming` record — batch id,
+    offset range, rows in/out, state persistence kind (delta vs
+    snapshot) + bytes, quarantined files, sink parts. The event-log
+    listener writes it as its own (schema v4, additive) line;
+    `history.streaming_summary` replays it."""
+
+    query_id: int
+    ts: float
+    plan: str
+    record: Dict = field(default_factory=dict)
+
+
+@dataclass
 class QueryEndEvent:
     """Posted when an execution finishes (status 'ok') or fails past
     recovery (status 'error'). `event` is the full event-log record —
@@ -140,7 +155,8 @@ class QueryEndEvent:
 #: callback names the bus will deliver (anything else is a bug)
 CALLBACKS = ("on_query_start", "on_analysis", "on_stage_compiled",
              "on_stage_completed", "on_fault", "on_query_end",
-             "on_service", "on_shard_records", "on_straggler")
+             "on_service", "on_shard_records", "on_straggler",
+             "on_streaming_batch")
 
 
 class QueryListener:
@@ -177,6 +193,9 @@ class QueryListener:
         pass
 
     def on_straggler(self, event: StragglerEvent) -> None:
+        pass
+
+    def on_streaming_batch(self, event: StreamingBatchEvent) -> None:
         pass
 
 

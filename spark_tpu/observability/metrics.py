@@ -68,6 +68,14 @@ METRIC_PREFIXES = (
     # straggler rebalancer shifted off flagged shards
     "mesh_restart_",   # mesh_restart_attempts: gang restarts applied
     "rebalance_",      # rebalance_rows: rows shifted off flagged shards
+    # durable streaming (streaming.py + execution/state_store.py):
+    # REGISTRY counters, listed for namespace closure — micro-batches
+    # committed / input rows, incremental state-store bytes (delta vs
+    # snapshot), restore wall-clock, quarantined source files and
+    # corrupt metadata-log entries skipped
+    "streaming_",      # streaming_batches/_rows/_state_delta_bytes/
+                       # _state_snapshot_bytes/_restore_ms/
+                       # _files_quarantined/_log_corrupt
 )
 
 

@@ -14,14 +14,16 @@ import os
 import re
 import warnings
 
-from .listener import QueryEndEvent, QueryListener
+from .listener import QueryEndEvent, QueryListener, StreamingBatchEvent
 from .spans import to_chrome_trace
 
 # v3: per-shard telemetry (`shards` records + `shards_dropped`), the
 # runtime-annotated `plan_tree`, and `predictions` (analyzer
-# self-grading). Purely additive — v2 logs replay unchanged
-# (scripts/events_tool.py validates both).
-EVENT_LOG_SCHEMA_VERSION = 3
+# self-grading). v4: the per-batch `streaming` record (micro-batch
+# lifecycle: offsets, delta-vs-snapshot state bytes, quarantines).
+# Purely additive — older logs replay unchanged
+# (scripts/events_tool.py validates every published version).
+EVENT_LOG_SCHEMA_VERSION = 4
 
 
 def json_default(o):
@@ -109,6 +111,24 @@ class EventLogListener(QueryListener):
             # never fail a completed query over observability I/O
             warnings.warn(f"event log write failed: {e}")
 
+    def on_streaming_batch(self, event: StreamingBatchEvent) -> None:
+        """One (schema v4) line per committed micro-batch: the
+        `streaming` record next to the regular per-execution lines, so
+        `history.streaming_summary` replays batch lifecycle from the
+        same log."""
+        log_dir = str(self._session.conf.get(self.DIR_KEY))
+        if not log_dir:
+            return
+        line_event = {
+            "ts": event.ts, "query_id": event.query_id, "status": "ok",
+            "plan": event.plan,
+            "schema_version": EVENT_LOG_SCHEMA_VERSION,
+            "streaming": event.record,
+        }
+        self.on_query_end(QueryEndEvent(
+            query_id=event.query_id, ts=event.ts, status="ok",
+            event=line_event))
+
 
 class ChromeTraceListener(QueryListener):
     """Writes `<trace.dir>/query-<app_id>-<id>.trace.json` per
@@ -195,6 +215,13 @@ class MetricsSinkListener(QueryListener):
         except Exception:  # noqa: BLE001 — gauges are best-effort
             pass
         m.flush(self._session.conf)
+
+    def on_streaming_batch(self, event: StreamingBatchEvent) -> None:
+        # the streaming_* counters are incremented at the source
+        # (StreamingQuery / StateStore); per-batch flush keeps the
+        # exposition file current for long-running streams that never
+        # execute a regular (query-end-posting) batch query
+        self._session.metrics.flush(self._session.conf)
 
 
 def install_default_listeners(session) -> None:

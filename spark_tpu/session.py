@@ -260,6 +260,18 @@ class SparkTpuSession(metaclass=_ActiveSessionMeta):
         from .io.sources import JsonSource
         return DataFrame(self, L.Scan(JsonSource(path, name)))
 
+    def file_stream(self, path: str, schema_df=None,
+                    format: str = "parquet"):
+        """Directory-tailing streaming source (the readStream analog):
+        returns a FileStreamSource whose `.to_df()` feeds
+        `DataFrame.write_stream`. Offsets are a persisted seen-file
+        log under the query's checkpoint; corrupt files quarantine
+        instead of wedging the stream (see
+        spark_tpu.streaming.source.file.strict)."""
+        from .streaming import FileStreamSource
+        return FileStreamSource(self, path, schema_df=schema_df,
+                                format=format)
+
     def long_accumulator(self, name: str = "acc") -> "Accumulator":
         return Accumulator(name, 0)
 

@@ -9,25 +9,50 @@ streaming core (`execution/streaming/MicroBatchExecution.scala:39`,
    `HDFSMetadataLog` analog — JSON files named by batch id);
 2. runs the query over exactly the logged range — stateless plans
    execute the batch slice through the normal engine; streaming
-   aggregations fold the slice into versioned accumulator tables (the
-   `StateStore:101` role is played by the direct-aggregate tables that
-   already power batch streaming);
-3. commits to the commit log (`commitLog:226`) and emits to the sink.
+   aggregations fold the slice into versioned accumulator tables whose
+   persistence is the incremental state store
+   (`execution/state_store.py`: changed-group deltas between periodic
+   snapshots, the RocksDBStateStoreProvider seat);
+3. emits to the sink, then commits to the commit log (`commitLog:226`).
 
-Exactly-once = offset log ∧ commit log ∧ versioned state: on restart,
-a planned-but-uncommitted batch re-runs over the SAME logged range
-against the last committed state version, so replays are idempotent.
+Exactly-once = offset log ∧ versioned state ∧ idempotent sinks: on
+restart, a planned-but-uncommitted batch re-runs over the SAME logged
+range against the last committed state version, and sinks are keyed by
+batch id (the memory sink replaces a replayed batch's entry; the file
+sink's atomic per-batch manifest makes a replay overwrite its own
+parts), so replays change nothing. The in-memory state is only adopted
+AFTER the commit-log write, so an in-process failure anywhere in the
+batch leaves the query at the committed version — retrying
+`process_available()` on the same object is as safe as a restart.
+
+Crash seams: `stream_source_list`, `stream_offset_write`,
+`stream_state_commit` and `stream_sink_emit` (testing/faults.py) each
+fire before their boundary's action; the durability chaos matrix
+(tests/test_streaming_durability.py) kills the loop at every seam and
+proves a fresh query over the same checkpoint loses and duplicates
+nothing.
+
+Sources: `MemoryStream` (the deterministic test source) and
+`FileStreamSource` (directory tailing with a persisted seen-file log;
+corrupt files quarantine instead of wedging the stream). Sink:
+in-memory results per batch, optionally tee'd to a `FileStreamSink`
+(per-batch parquet parts + `_metadata` manifest — readers only see
+manifested batches).
 
 The TPU angle: each micro-batch is one jitted SPMD program over a
 statically-shaped batch slice; state lives in HBM as accumulator tables
-between triggers (no RocksDB tier — state is bounded by the aggregate's
-padded domain, and the host checkpoint serializes it as numpy).
+between triggers. Persistence pulls the full tables to host each
+trigger and diffs there — the device->host transfer is O(state), but
+only the CHANGED groups reach DISK (the delta), which is where the
+per-trigger durability cost used to be O(state) too.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import time
+import warnings
 from typing import Dict, List, Optional
 
 import jax
@@ -40,10 +65,93 @@ from . import functions as F  # noqa: F401  (user convenience re-export)
 from .columnar import Batch
 from .plan import logical as L
 
+FILE_STRICT_KEY = "spark_tpu.streaming.source.file.strict"
+RETAIN_KEY = "spark_tpu.streaming.retainBatches"
+
+
+class _MetadataLog:
+    """Numbered JSON files with atomic rename — the
+    `HDFSMetadataLog`/`CheckpointFileManager` contract in miniature.
+
+    Durability: entries are flushed + fsync'd before the rename, so a
+    power cut can tear at most the not-yet-renamed tmp file. A torn or
+    empty NEWEST entry (crash mid-write on a filesystem that reordered
+    the flush) is skipped by `latest()` with a warning and the
+    `streaming_log_corrupt` counter — recovery falls back one entry
+    instead of crashing the whole restart."""
+
+    def __init__(self, path: str, metrics=None):
+        self.path = path
+        self.metrics = metrics
+        os.makedirs(path, exist_ok=True)
+
+    def _ids(self) -> List[int]:
+        return sorted(int(f) for f in os.listdir(self.path)
+                      if f.isdigit())
+
+    def _read(self, i: int):
+        with open(os.path.join(self.path, str(i))) as f:
+            return json.load(f)
+
+    def _note_corrupt(self, i: int, exc) -> None:
+        warnings.warn(
+            f"skipping corrupt metadata log entry "
+            f"{os.path.join(self.path, str(i))} "
+            f"({type(exc).__name__}: {exc}); falling back to the "
+            f"previous entry")
+        if self.metrics is not None:
+            self.metrics.counter("streaming_log_corrupt").inc()
+
+    def latest(self):
+        for i in reversed(self._ids()):
+            try:
+                return i, self._read(i)
+            except (ValueError, OSError) as e:
+                # a torn/empty newest entry must not wedge recovery
+                self._note_corrupt(i, e)
+        return None, None
+
+    def read_all(self) -> List[dict]:
+        """Entries 0..n-1 in id order, stopping at the first gap or
+        corrupt entry (entries are written in order, so anything past
+        a tear is from a torn future, not the committed past)."""
+        out: List[dict] = []
+        for want, i in enumerate(self._ids()):
+            if i != want:
+                break
+            try:
+                out.append(self._read(i))
+            except (ValueError, OSError) as e:
+                self._note_corrupt(i, e)
+                break
+        return out
+
+    def read_all_items(self) -> List[tuple]:
+        """(id, payload) for every readable entry, id order — ids may
+        be sparse (the file sink's manifest skips batches that emitted
+        nothing)."""
+        out = []
+        for i in self._ids():
+            try:
+                out.append((i, self._read(i)))
+            except (ValueError, OSError) as e:
+                self._note_corrupt(i, e)
+        return out
+
+    def add(self, batch_id: int, payload: dict) -> None:
+        from .execution.state_store import fsync_replace
+        final = os.path.join(self.path, str(batch_id))
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        fsync_replace(tmp, final)
+
 
 class MemoryStream:
     """An appendable in-memory source (the reference's `MemoryStream` —
     the deterministic test source behind StreamTest.scala:342)."""
+
+    source_kind = "memory"
 
     def __init__(self, session, schema_df: pd.DataFrame):
         self.session = session
@@ -55,6 +163,9 @@ class MemoryStream:
         self._batches.append(pa.Table.from_pandas(df, preserve_index=False))
 
     addData = add_data
+
+    def attach_checkpoint(self, path: str) -> None:
+        pass  # in-memory offsets need no persisted seen log
 
     def latest_offset(self) -> int:
         return len(self._batches)
@@ -73,10 +184,136 @@ class MemoryStream:
         return DataFrame(self.session, _StreamSource(self))
 
 
+class FileStreamSource:
+    """Directory-tailing source (the `FileStreamSource.scala:73`
+    analog): offsets are indices into a PERSISTED seen-file log
+    (`<checkpoint>/sources/0/`, one fsync'd JSON entry per discovered
+    file, discovery ordered by (mtime, name)), so a restart replays
+    exactly the files a planned batch covered.
+
+    Corrupt/partial files: a file that fails to decode is QUARANTINED
+    — the failure is recorded into its seen-log entry, the
+    `streaming_files_quarantined` counter ticks, and the batch (and
+    every replay of it) skips the file — unless
+    `spark_tpu.streaming.source.file.strict` is set, in which case the
+    batch fails instead."""
+
+    source_kind = "file"
+
+    def __init__(self, session, path: str,
+                 schema_df: Optional[pd.DataFrame] = None,
+                 format: str = "parquet"):
+        from .io.sources import decode_stream_file, list_stream_files
+        self.session = session
+        self.path = path
+        self.format = format
+        os.makedirs(path, exist_ok=True)
+        if schema_df is not None:
+            self._table = pa.Table.from_pandas(schema_df.iloc[0:0],
+                                               preserve_index=False)
+        else:
+            entries = list_stream_files(path)
+            if not entries:
+                raise ValueError(
+                    f"file stream over empty directory {path!r} needs "
+                    f"an explicit schema_df (no file to infer from)")
+            first = decode_stream_file(
+                os.path.join(path, entries[0]["name"]), format)
+            self._table = first.slice(0, 0)
+        self._seen: List[dict] = []
+        self._log: Optional[_MetadataLog] = None
+
+    def attach_checkpoint(self, path: str) -> None:
+        """Bind (or re-bind on restart) the persisted seen-file log;
+        the log on disk is authoritative over any in-memory view."""
+        self._log = _MetadataLog(path, metrics=self.session.metrics)
+        self._seen = self._log.read_all()
+
+    def _persist(self, idx: int) -> None:
+        if self._log is not None:
+            self._log.add(idx, self._seen[idx])
+
+    def latest_offset(self) -> int:
+        """Discover new files and append them to the seen log; the
+        offset is simply how many files have ever been seen."""
+        from .io.sources import list_stream_files
+        known = {e["name"] for e in self._seen}
+        for e in list_stream_files(self.path):
+            if e["name"] in known:
+                continue
+            e["quarantined"] = None
+            self._seen.append(e)
+            self._persist(len(self._seen) - 1)
+        return len(self._seen)
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        from .io.sources import decode_stream_file
+        if end > len(self._seen):
+            # a torn seen-log tail lost entries a PLANNED offset range
+            # covers. Discovery order is deterministic ((mtime, name),
+            # already-seen names skipped), so re-discovering appends
+            # the lost files back at their original indices — the
+            # self-healing path. Still short afterwards = the files
+            # themselves are gone: fail loudly rather than silently
+            # committing a batch that skipped planned data.
+            self.latest_offset()
+        if end > len(self._seen):
+            raise RuntimeError(
+                f"seen-file log under {self.path!r} has "
+                f"{len(self._seen)} entries but the planned offset "
+                f"range is [{start}, {end}): files covered by a "
+                f"planned batch vanished; cannot recover exactly-once")
+        strict = bool(self.session.conf.get(FILE_STRICT_KEY))
+        tables = []
+        for i in range(start, end):
+            entry = self._seen[i]
+            if entry.get("quarantined"):
+                continue  # quarantined on a previous attempt: stays out
+            full = os.path.join(self.path, entry["name"])
+            try:
+                t = decode_stream_file(full, self.format)
+                t = self._conform(t)
+            except Exception as e:  # noqa: BLE001 — decode = quarantine
+                if strict:
+                    raise RuntimeError(
+                        f"stream file {full!r} failed to decode under "
+                        f"streaming.source.file.strict: "
+                        f"{type(e).__name__}: {e}") from e
+                entry["quarantined"] = f"{type(e).__name__}: {e}"[:200]
+                self._persist(i)
+                self.session.metrics.counter(
+                    "streaming_files_quarantined").inc()
+                warnings.warn(
+                    f"quarantined corrupt stream file {full!r}: "
+                    f"{entry['quarantined']}")
+                continue
+            if t.num_rows:
+                tables.append(t)
+        if not tables:
+            return self._table
+        return pa.concat_tables(tables)
+
+    def _conform(self, t: pa.Table) -> pa.Table:
+        """Project/cast a decoded file onto the stream schema; a file
+        that cannot conform is as corrupt as one that cannot parse."""
+        if t.schema == self._table.schema:
+            return t
+        return t.select(self._table.column_names).cast(self._table.schema)
+
+    def quarantined(self) -> List[dict]:
+        """The quarantined seen-log entries (path + failure reason)."""
+        return [dict(e, path=os.path.join(self.path, e["name"]))
+                for e in self._seen if e.get("quarantined")]
+
+    def to_df(self):
+        from .dataframe import DataFrame
+        return DataFrame(self.session, _StreamSource(self))
+
+
 class _StreamSource(L.LeafPlan):
     """Logical placeholder for a streaming source."""
 
-    def __init__(self, stream: MemoryStream):
+    def __init__(self, stream):
         self.stream = stream
         self.children = ()
 
@@ -85,31 +322,88 @@ class _StreamSource(L.LeafPlan):
         return ArrowTableSource("__stream__", self.stream._table).schema()
 
     def simple_string(self):
-        return "StreamSource(memory)"
+        return f"StreamSource({getattr(self.stream, 'source_kind', '?')})"
 
 
-class _MetadataLog:
-    """Numbered JSON files with atomic rename — the
-    `HDFSMetadataLog`/`CheckpointFileManager` contract in miniature."""
+class FileStreamSink:
+    """Per-batch parquet parts committed by an atomic batch manifest —
+    the `FileStreamSink.scala` / `_spark_metadata` contract: a part
+    file only exists for readers once its batch's manifest entry
+    landed (fsync + atomic rename), and a REPLAYED batch rewrites its
+    own deterministically-named parts, so crash-replay can neither
+    lose nor duplicate sink rows."""
 
-    def __init__(self, path: str):
+    def __init__(self, session, path: str, output_mode: str):
+        self.session = session
         self.path = path
+        self.output_mode = output_mode
         os.makedirs(path, exist_ok=True)
+        self._manifest = _MetadataLog(os.path.join(path, "_metadata"),
+                                      metrics=session.metrics)
 
-    def latest(self):
-        ids = [int(f) for f in os.listdir(self.path) if f.isdigit()]
-        if not ids:
-            return None, None
-        i = max(ids)
-        with open(os.path.join(self.path, str(i))) as f:
-            return i, json.load(f)
+    def emit(self, batch_id: int, pdf: pd.DataFrame) -> int:
+        import pyarrow.parquet as pq
+        from .execution.state_store import fsync_replace
+        name = f"part-{batch_id:05d}.parquet"
+        full = os.path.join(self.path, name)
+        tmp = full + ".tmp"
+        pq.write_table(pa.Table.from_pandas(pdf, preserve_index=False),
+                       tmp)
+        fsync_replace(tmp, full)
+        self._manifest.add(batch_id, {"parts": [name],
+                                      "rows": int(len(pdf)),
+                                      "mode": self.output_mode})
+        return 1
 
-    def add(self, batch_id: int, payload: dict) -> None:
-        final = os.path.join(self.path, str(batch_id))
-        tmp = final + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, final)
+    def prune(self, committed: int, retain: int) -> None:
+        """Complete-mode garbage collection: every batch rewrites the
+        FULL result, so parts superseded by more than the retention
+        window are dead weight — retire their manifest entries and
+        files. Append-mode parts ARE the data and are never pruned."""
+        if self.output_mode != "complete":
+            return
+        floor = committed - int(retain)
+        for batch_id, payload in self._manifest.read_all_items():
+            if batch_id >= floor:
+                continue
+            try:
+                os.remove(os.path.join(self._manifest.path,
+                                       str(batch_id)))
+            except OSError:
+                pass
+            for part in payload.get("parts", []):
+                try:
+                    os.remove(os.path.join(self.path, part))
+                except OSError:
+                    pass
+
+    @staticmethod
+    def read(path: str) -> pd.DataFrame:
+        """Manifested rows only (unmanifested parts are invisible —
+        they belong to a batch that never committed). Append-mode
+        output concatenates every manifested batch; complete-mode
+        output is the LATEST manifested batch (each batch rewrites the
+        whole result)."""
+        log = _MetadataLog(os.path.join(path, "_metadata"))
+        items = log.read_all_items()
+        if not items:
+            return pd.DataFrame()
+        mode = items[-1][1].get("mode", "append")
+        if mode == "complete":
+            items = items[-1:]
+        frames = []
+        for _, payload in items:
+            for part in payload.get("parts", []):
+                frames.append(pd.read_parquet(os.path.join(path, part)))
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
+
+
+def read_sink(path: str) -> pd.DataFrame:
+    """Module-level alias of FileStreamSink.read (the reader side of
+    the manifest contract)."""
+    return FileStreamSink.read(path)
 
 
 class StreamingQuery:
@@ -117,20 +411,27 @@ class StreamingQuery:
     manual (`process_available()`) — the deterministic single-step mode
     StreamTest uses; a wall-clock trigger is a loop around it."""
 
-    def __init__(self, session, plan: L.LogicalPlan, stream: MemoryStream,
-                 checkpoint_dir: str, output_mode: str = "complete"):
+    def __init__(self, session, plan: L.LogicalPlan, stream,
+                 checkpoint_dir: str, output_mode: str = "complete",
+                 sink_path: Optional[str] = None):
         if output_mode not in ("complete", "append"):
             raise ValueError(f"unsupported outputMode {output_mode!r}")
         self.session = session
         self.plan = plan
         self.stream = stream
         self.output_mode = output_mode
-        self.offset_log = _MetadataLog(os.path.join(checkpoint_dir,
-                                                    "offsets"))
-        self.commit_log = _MetadataLog(os.path.join(checkpoint_dir,
-                                                    "commits"))
+        self.offset_log = _MetadataLog(
+            os.path.join(checkpoint_dir, "offsets"),
+            metrics=session.metrics)
+        self.commit_log = _MetadataLog(
+            os.path.join(checkpoint_dir, "commits"),
+            metrics=session.metrics)
+        from .execution.state_store import StateStore
         self._state_dir = os.path.join(checkpoint_dir, "state")
-        os.makedirs(self._state_dir, exist_ok=True)
+        self._store = StateStore(self._state_dir, session.conf,
+                                 metrics=session.metrics)
+        stream.attach_checkpoint(
+            os.path.join(checkpoint_dir, "sources", "0"))
         self._agg = self._find_aggregate(plan)
         self._watermark = self._find_watermark(plan)
         self._event_time = (self._agg is not None
@@ -144,9 +445,16 @@ class StreamingQuery:
                 "outputMode='append' on a streaming aggregation needs "
                 "a watermark (with_watermark) so closed windows can be "
                 "emitted exactly once; use 'complete' otherwise")
-        self._results: List[pd.DataFrame] = []
-        self._tables = None      # carried aggregate state (device)
+        #: memory sink keyed by BATCH ID: a replayed batch REPLACES its
+        #: own entry instead of appending a duplicate (exactly-once at
+        #: the sink, not just the state)
+        self._sink_results: Dict[int, pd.DataFrame] = {}
+        self._file_sink = (FileStreamSink(session, sink_path, output_mode)
+                           if sink_path else None)
+        self._tables = None      # committed aggregate state (device)
+        self._flat = None        # committed aggregate state (host copy)
         self._prep = None
+        self._pending = None     # post-batch state awaiting commit
         # event-time path: host state table + watermark (us)
         self._evstate: Optional[pd.DataFrame] = None
         self._wm: int = -(1 << 62)
@@ -186,56 +494,83 @@ class StreamingQuery:
         walk(plan)
         return found[0] if found else None
 
+    def _shape(self) -> str:
+        if self._event_time:
+            return "event_time"
+        return "stateful" if self._agg is not None else "stateless"
+
     # -- recovery -----------------------------------------------------------
 
     def _recover(self) -> None:
         """Restart semantics: resume state at the last COMMITTED batch;
         a planned-but-uncommitted offset entry will re-run over its
-        logged range (idempotent because state is versioned)."""
+        logged range (idempotent because state is versioned and sinks
+        are batch-id keyed)."""
+        t0 = time.perf_counter()
         last_commit, payload = self.commit_log.latest()
         self._committed_batch = -1 if last_commit is None else last_commit
+        # the committed batch's END offset: the floor for the next
+        # planned range. Guards the asymmetric-corruption case — the
+        # offset log's newest entry torn while its commit survived —
+        # where falling back one OFFSET entry would re-plan (and
+        # double-fold) a range the committed state already contains.
+        self._committed_end = int((payload or {}).get("end", 0)) \
+            if last_commit is not None else 0
         if self._agg is not None and last_commit is not None:
             if self._event_time:
                 self._wm = int((payload or {}).get("wm", self._wm))
-                p = self._event_state_path(last_commit)
-                if os.path.exists(p):
-                    self._evstate = pd.read_parquet(p)
+                self._evstate = self._store.load_frame(last_commit)
             else:
                 self._load_state(last_commit)
+            self.session.metrics.counter("streaming_restore_ms").inc(
+                round((time.perf_counter() - t0) * 1e3, 3))
 
-    def _state_path(self, batch_id: int) -> str:
-        return os.path.join(self._state_dir, f"v{batch_id}.npz")
-
-    def _save_state(self, batch_id: int, tables) -> None:
+    def _save_state(self, batch_id: int, tables) -> dict:
+        """Persist the POST-batch accumulator tables as version
+        `batch_id` through the incremental state store (delta of the
+        changed groups, or a snapshot on the cadence)."""
         cnt, accs = tables
         flat = {"cnt": np.asarray(cnt)}
         for i, row in enumerate(accs):
             for j, a in enumerate(row):
                 flat[f"acc_{i}_{j}"] = np.asarray(a)
-        tmp = self._state_path(batch_id) + ".tmp.npz"
-        np.savez(tmp, **flat)
-        os.replace(tmp, self._state_path(batch_id))
+        info = self._store.commit_tables(batch_id, flat, self._flat)
+        self._pending = {"tables": tables, "flat": flat}
+        return info
 
     def _load_state(self, batch_id: int) -> None:
         self._ensure_prep()
-        with np.load(self._state_path(batch_id)) as z:
-            cnt = jnp.asarray(z["cnt"])
-            accs = []
-            i = 0
-            while f"acc_{i}_0" in z:
-                row = []
-                j = 0
-                while f"acc_{i}_{j}" in z:
-                    row.append(jnp.asarray(z[f"acc_{i}_{j}"]))
-                    j += 1
-                accs.append(row)
-                i += 1
+        flat = self._store.load_tables(batch_id)
+        cnt = jnp.asarray(flat["cnt"])
+        accs = []
+        i = 0
+        while f"acc_{i}_0" in flat:
+            row = []
+            j = 0
+            while f"acc_{i}_{j}" in flat:
+                row.append(jnp.asarray(flat[f"acc_{i}_{j}"]))
+                j += 1
+            accs.append(row)
+            i += 1
         self._tables = (cnt, accs)
+        self._flat = flat
+
+    def _adopt_pending(self) -> None:
+        """Adopt the post-batch state AFTER the commit-log write: an
+        in-process failure anywhere in the batch leaves the query at
+        the committed version, so re-calling process_available() on
+        the same object replays exactly like a fresh restart."""
+        p, self._pending = self._pending, None
+        if p is None:
+            return
+        if "tables" in p:
+            self._tables = p["tables"]
+            self._flat = p["flat"]
+        if "evstate" in p:
+            self._evstate = p["evstate"]
+            self._wm = p["wm"]
 
     # -- event-time (watermark) path ----------------------------------------
-
-    def _event_state_path(self, batch_id: int) -> str:
-        return os.path.join(self._state_dir, f"ev_v{batch_id}.parquet")
 
     def _ensure_event_prep(self):
         """Build the per-trigger PARTIAL-aggregate program: chain replay
@@ -294,7 +629,6 @@ class StreamingQuery:
 
         self._ev_update = jax.jit(update)
 
-
     def _event_merge(self, state: Optional[pd.DataFrame],
                      partial_pdf: pd.DataFrame) -> pd.DataFrame:
         """Fold a trigger's partial table into the state with each
@@ -322,7 +656,7 @@ class StreamingQuery:
             out[a.out_name] = vals.to_numpy()
         return pd.DataFrame(out)
 
-    def _run_batch_event(self, batch_id: int, table: pa.Table) -> None:
+    def _run_batch_event(self, batch_id: int, table: pa.Table):
         import pyarrow.compute as pc
         self._ensure_event_prep()
         col, delay = self._watermark
@@ -363,23 +697,23 @@ class StreamingQuery:
                 emitted = new_state[closed]
                 new_state = new_state[~closed].reset_index(drop=True)
 
-        # persist BEFORE adopting (exactly-once on replay)
-        tmp = self._event_state_path(batch_id) + ".tmp"
-        (new_state if new_state is not None else
-         pd.DataFrame()).to_parquet(tmp)
-        os.replace(tmp, self._event_state_path(batch_id))
-        self._evstate = new_state
-        self._wm = wm
+        # persist BEFORE emitting/adopting (exactly-once on replay):
+        # the store diffs against the COMMITTED state and writes a
+        # changed-rows delta (or a snapshot on the cadence)
+        info = self._store.commit_frame(batch_id, new_state,
+                                        self._evstate,
+                                        self._ev_group_cols)
+        self._pending = {"evstate": new_state, "wm": wm}
 
+        out = None
         if self.output_mode == "complete":
             if new_state is not None and len(new_state):
-                self._results.append(
-                    self._apply_above(self._event_finalize(new_state)))
+                out = self._apply_above(self._event_finalize(new_state))
             else:
-                self._results.append(pd.DataFrame())
+                out = pd.DataFrame()
         elif emitted is not None and len(emitted):
-            self._results.append(
-                self._apply_above(self._event_finalize(emitted)))
+            out = self._apply_above(self._event_finalize(emitted))
+        return out, info
 
     def _apply_above(self, pdf: pd.DataFrame) -> pd.DataFrame:
         """Re-apply operators above the aggregate (HAVING/ORDER BY/...)
@@ -504,6 +838,14 @@ class StreamingQuery:
                 "integer group domain (e.g. pmod keys)")
         self._prep = prep
 
+        if getattr(self.stream, "source_kind", "memory") == "file" \
+                and any(a.func.uses_row_base
+                        for a in agg_exec.agg_exprs):
+            raise ValueError(
+                "first/last are not supported over file stream sources "
+                "(file offsets are file indices, not row positions, so "
+                "packed positions would collide across batches)")
+
         def update(tables, b, row_base):
             ctx = ExecContext(self.session.conf)
             for op in reversed(self._chain):
@@ -525,9 +867,17 @@ class StreamingQuery:
     def process_available(self) -> None:
         """Run micro-batches until the source is drained (the
         `Trigger.AvailableNow` analog; each iteration = one batch of the
-        `MicroBatchExecution` loop)."""
+        `MicroBatchExecution` loop). Loop order per batch: source list
+        -> offset write -> run (state commit) -> sink emit -> commit
+        log -> adopt state -> prune; the stream_* chaos seams fire
+        before each persistent action."""
+        from .testing import faults
+        faults.arm(self.session.conf)
         while True:
+            self._pending = None
             batch_id = self._committed_batch + 1
+            # chaos seam: a crash before the loop even polls the source
+            faults.fire("stream_source_list")
             planned_id, planned = self.offset_log.latest()
             if planned_id is not None and planned_id == batch_id:
                 # planned but not committed (crash between the logs):
@@ -535,50 +885,71 @@ class StreamingQuery:
                 start, end = planned["start"], planned["end"]
             else:
                 start = planned["end"] if planned is not None else 0
+                # never re-plan below the committed watermark: a torn
+                # newest OFFSET entry whose commit survived would
+                # otherwise hand back an already-folded range
+                start = max(start, self._committed_end)
                 end = self.stream.latest_offset()
                 if end <= start:
                     return  # drained
-                self.offset_log.add(batch_id, {"start": start, "end": end})
-            self._run_batch(batch_id, start, end)
-            payload = {"ok": True}
+                # chaos seam: crash before the planned range persists
+                faults.fire("stream_offset_write")
+                self.offset_log.add(batch_id, {"start": start,
+                                               "end": end})
+            t0 = time.perf_counter()
+            q0 = self.session.metrics.counter(
+                "streaming_files_quarantined").value
+            out, info = self._run_batch(batch_id, start, end)
+            # chaos seam: state committed, sink not yet emitted
+            faults.fire("stream_sink_emit")
+            sink_parts = self._emit(batch_id, out)
+            # `end` rides the commit entry: recovery floors the next
+            # planned range at it (see _recover)
+            payload = {"ok": True, "end": int(end)}
             if self._event_time:
-                payload["wm"] = int(self._wm)
+                payload["wm"] = int(self._pending["wm"])
             self.commit_log.add(batch_id, payload)
             self._committed_batch = batch_id
+            self._committed_end = int(end)
+            self._adopt_pending()
+            self._record_batch(
+                batch_id, start, end, out, info,
+                wall_ms=(time.perf_counter() - t0) * 1e3,
+                quarantined=int(self.session.metrics.counter(
+                    "streaming_files_quarantined").value - q0),
+                sink_parts=sink_parts)
             self._prune(batch_id)
 
-    def _prune(self, committed: int, retain: int = 2) -> None:
-        """Drop state versions and log entries older than the retained
-        window (the reference's minBatchesToRetain); recovery only ever
-        reads the last committed version."""
+    def _prune(self, committed: int) -> None:
+        """Drop log entries older than the retained window (the
+        reference's minBatchesToRetain) and let the state store
+        compact deltas/snapshots no retained restore needs; recovery
+        only ever reads the last committed version."""
+        retain = int(self.session.conf.get(RETAIN_KEY))
         floor = committed - retain
         for log in (self.offset_log, self.commit_log):
             for f in os.listdir(log.path):
                 if f.isdigit() and int(f) < floor:
                     os.remove(os.path.join(log.path, f))
-        for f in os.listdir(self._state_dir):
-            if f.startswith("ev_v") and f.endswith(".parquet"):
-                try:
-                    vid = int(f[4:-8])
-                except ValueError:
-                    continue
-                if vid < floor:
-                    os.remove(os.path.join(self._state_dir, f))
-            elif f.startswith("v") and f.endswith(".npz"):
-                try:
-                    vid = int(f[1:-4])
-                except ValueError:
-                    continue
-                if vid < floor:
-                    os.remove(os.path.join(self._state_dir, f))
+        self._store.prune(committed, retain)
+        if self._file_sink is not None:
+            self._file_sink.prune(committed, retain)
+        if self.output_mode == "complete":
+            # complete mode rewrites the FULL result per batch: memory
+            # -sink entries outside the window are superseded dead
+            # weight on a long-running stream (append entries ARE the
+            # data and stay)
+            for k in [k for k in self._sink_results if k < floor]:
+                del self._sink_results[k]
 
     processAllAvailable = process_available
 
-    def _run_batch(self, batch_id: int, start: int, end: int) -> None:
+    def _run_batch(self, batch_id: int, start: int, end: int):
         table = self.stream.slice(start, end)
         if self._event_time:
-            self._run_batch_event(batch_id, table)
-            return
+            out, info = self._run_batch_event(batch_id, table)
+            info["rows_in"] = int(table.num_rows)
+            return out, info
         if self._agg is None:
             # stateless: swap the stream placeholder for this slice and
             # run the normal engine
@@ -596,12 +967,14 @@ class StreamingQuery:
             from .execution.executor import QueryExecution
             out = QueryExecution(
                 self.session, self.plan.transform_down(swap)).collect()
-            self._results.append(out.to_pandas())
-            return
+            return out.to_pandas(), {"kind": "stateless", "bytes": None,
+                                     "changed": None,
+                                     "rows_in": int(table.num_rows)}
         # stateful: fold the slice into carried accumulator tables
         self._ensure_prep()
         if self._tables is None:
             self._tables = self._agg_exec.direct_init_tables(self._prep)
+            self._flat = None
         new_tables = self._tables
         if table.num_rows:
             b = self._batch_for(table)
@@ -611,31 +984,75 @@ class StreamingQuery:
                 raise RuntimeError(
                     "first/last over a stream exceeds the 2^30 "
                     "packed-position bound")
-            import jax.numpy as jnp
             new_tables = self._update(self._tables, b,
                                       jnp.asarray(start, jnp.int64))
-        # persist BEFORE adopting: a save failure must leave the
-        # pre-update tables in place so an in-process retry replays the
-        # same range without double-counting
-        self._save_state(batch_id, new_tables)
-        self._tables = new_tables
-        out = self._agg_exec.direct_finalize_tables(self._tables,
+        # persist BEFORE emitting/adopting: the incremental store
+        # diffs the host copies against the committed version and
+        # writes only the changed groups (or a snapshot on cadence)
+        info = self._save_state(batch_id, new_tables)
+        info["rows_in"] = int(table.num_rows)
+        out = self._agg_exec.direct_finalize_tables(new_tables,
                                                     self._prep)
         from .plan.physical import ExecContext
         ctx = ExecContext(self.session.conf)
         for op in reversed(self._above):
             out = op.compute(ctx, [out])
-        self._results.append(out.to_arrow().to_pandas())
+        return out.to_arrow().to_pandas(), info
 
     # -- sink ---------------------------------------------------------------
 
+    def _emit(self, batch_id: int, out: Optional[pd.DataFrame]) -> int:
+        """Route a batch's output to the sinks, KEYED BY BATCH ID: a
+        replayed batch replaces its own memory-sink entry, and the file
+        sink's manifest makes the part overwrite invisible until
+        re-manifested."""
+        if out is None:
+            return 0
+        self._sink_results[batch_id] = out
+        if self._file_sink is not None:
+            return self._file_sink.emit(batch_id, out)
+        return 0
+
+    def _record_batch(self, batch_id: int, start: int, end: int, out,
+                      info: dict, wall_ms: float, quarantined: int,
+                      sink_parts: int) -> None:
+        m = self.session.metrics
+        m.counter("streaming_batches").inc()
+        m.counter("streaming_rows").inc(int(info.get("rows_in") or 0))
+        record = {
+            "batch_id": int(batch_id),
+            "start": int(start), "end": int(end),
+            "rows_in": int(info.get("rows_in") or 0),
+            "rows_out": int(len(out)) if out is not None else 0,
+            "kind": str(info.get("kind") or "stateless"),
+            "state_bytes": info.get("bytes"),
+            "changed_groups": info.get("changed"),
+            "quarantined": int(quarantined),
+            "sink_parts": int(sink_parts),
+            "source": str(getattr(self.stream, "source_kind", "memory")),
+            "wall_ms": round(float(wall_ms), 3),
+        }
+        from .observability.listener import StreamingBatchEvent
+        self.session.listeners.post(
+            "on_streaming_batch",
+            StreamingBatchEvent(
+                query_id=self.session._next_query_id(), ts=time.time(),
+                plan=f"StreamingQuery[{self._shape()},"
+                     f"{self.output_mode}]",
+                record=record))
+
     def latest(self) -> Optional[pd.DataFrame]:
-        """Memory sink: the latest result table (complete mode) or the
-        last appended slice."""
-        return self._results[-1] if self._results else None
+        """Memory sink: the latest batch's result table (complete mode)
+        or the last appended slice."""
+        if not self._sink_results:
+            return None
+        return self._sink_results[max(self._sink_results)]
 
     def results(self) -> List[pd.DataFrame]:
-        return list(self._results)
+        """Every emitted batch's table in batch order (replays
+        replaced, never duplicated)."""
+        return [self._sink_results[k]
+                for k in sorted(self._sink_results)]
 
     def stop(self) -> None:
         pass  # manual trigger: nothing running between calls

@@ -16,7 +16,9 @@ Grammar (comma-separated rules):
     site  := scan_load | stage_compile | stage_run | shuffle
              | join_build | mesh | stream_chunk | mesh_checkpoint
              | ingest_prefetch | shard_chunk | mesh_restart
-             | decommission
+             | decommission | stream_source_list
+             | stream_offset_write | stream_state_commit
+             | stream_sink_emit
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
@@ -55,6 +57,18 @@ proves the ladder still lands on single-device fallback);
 `decommission` fires at the drain boundary, before the forced
 checkpoint (a raising rule models the drain machinery dying and rides
 the normal mesh ladder).
+
+The four `stream_*` micro-batch seams (streaming.py +
+execution/state_store.py) each fire BEFORE their boundary's action, so
+an armed `fatal` rule models a hard crash AT that point with nothing
+of the action persisted: `stream_source_list` before the loop polls
+the source for new offsets, `stream_offset_write` before the planned
+range lands in the offset log, `stream_state_commit` at every state
+-store commit entry (delta or snapshot, nothing written yet), and
+`stream_sink_emit` before the batch's output reaches the sink. The
+durability chaos matrix (tests/test_streaming_durability.py) kills a
+query at each seam, discards the object, and proves a fresh
+StreamingQuery over the same checkpoint recovers exactly-once.
 """
 
 from __future__ import annotations
@@ -74,7 +88,9 @@ INJECT_KEY = "spark_tpu.faults.inject"
 KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "join_build", "mesh", "stream_chunk", "mesh_checkpoint",
                "ingest_prefetch", "shard_chunk", "mesh_restart",
-               "decommission")
+               "decommission", "stream_source_list",
+               "stream_offset_write", "stream_state_commit",
+               "stream_sink_emit")
 
 #: test-registered extra seams (register_site): code under test may
 #: plant its own fire() points without editing the built-in tuple

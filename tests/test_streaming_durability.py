@@ -1,0 +1,838 @@
+"""Durable streaming: crash-point chaos matrix + incremental state
+store + file source/sink exactly-once proofs.
+
+The matrix kills the micro-batch loop at EVERY persistence seam
+(`stream_source_list` / `stream_offset_write` / `stream_state_commit`
+/ `stream_sink_emit`), discards the query object (the hard-crash
+simulation: in-memory state is gone, only the checkpoint dir
+survives), builds a fresh StreamingQuery over the same checkpoint and
+proves the recovered sink output is byte-identical to an
+uninterrupted run — no lost rows, no duplicated rows — for stateless,
+stateful-complete and event-time/watermark queries on both the memory
+and the file source."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.config import Conf
+from spark_tpu.execution.state_store import StateStore
+from spark_tpu.functions import col
+from spark_tpu.streaming import (FileStreamSink, FileStreamSource,
+                                 MemoryStream, _MetadataLog, read_sink)
+from spark_tpu.testing import faults
+
+SEAMS = ("stream_source_list", "stream_offset_write",
+         "stream_state_commit", "stream_sink_emit")
+
+SHAPES = ("stateless", "stateful", "event_time")
+
+
+# -- harness ----------------------------------------------------------------
+
+
+def _schema_df(shape):
+    if shape == "event_time":
+        return pd.DataFrame({"ts": [pd.Timestamp("2024-01-01")],
+                             "v": [0.0]})
+    return pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                         "v": pd.Series([], dtype=np.int64)})
+
+
+def _round_df(shape, i):
+    """Feed round i. Event-time rounds carry monotonically increasing
+    timestamps so late-data drop never depends on batch BOUNDARIES
+    (a crash before the offset write legally merges two rounds into
+    one batch; the comparison needs watermark-independent data)."""
+    if shape == "event_time":
+        base = pd.Timestamp("2024-01-01") + pd.Timedelta(seconds=30 * i)
+        return pd.DataFrame(
+            {"ts": [base, base + pd.Timedelta(seconds=4)],
+             "v": [float(i + 1), float(2 * i + 1)]})
+    return pd.DataFrame(
+        {"k": np.arange(6, dtype=np.int64) + i,
+         "v": np.arange(6, dtype=np.int64) * (i + 1)})
+
+
+def _plan(shape, src):
+    df = src.to_df()
+    if shape == "stateless":
+        return df.filter(col("v") >= 0), "append"
+    if shape == "stateful":
+        return (df.group_by(F.pmod(col("k"), 5).alias("g"))
+                .agg(F.sum(col("v")).alias("s"),
+                     F.count().alias("c")), "complete")
+    return (df.with_watermark("ts", "10 seconds")
+            .group_by(F.window(col("ts"), "10 seconds").alias("w"))
+            .agg(F.sum(col("v")).alias("s"),
+                 F.count().alias("c")), "complete")
+
+
+class _Feeder:
+    """One (shape, source) stream fixture: feeds rounds, builds
+    (fresh) queries over ONE persistent checkpoint + source + sink."""
+
+    def __init__(self, session, shape, source, base, tag):
+        self.session = session
+        self.shape = shape
+        self.source = source
+        self.src_dir = os.path.join(base, f"src_{tag}")
+        self.ck = os.path.join(base, f"ck_{tag}")
+        self.sink = os.path.join(base, f"sink_{tag}")
+        os.makedirs(self.src_dir, exist_ok=True)
+        self._mem = (MemoryStream(session, _schema_df(shape))
+                     if source == "memory" else None)
+        self._n = 0
+
+    def feed(self):
+        df = _round_df(self.shape, self._n)
+        self._n += 1
+        if self._mem is not None:
+            self._mem.add_data(df)
+        else:
+            df.to_parquet(os.path.join(self.src_dir,
+                                       f"r{self._n:03d}.parquet"))
+
+    def query(self):
+        src = self._mem if self._mem is not None else FileStreamSource(
+            self.session, self.src_dir,
+            schema_df=_schema_df(self.shape))
+        plan_df, mode = _plan(self.shape, src)
+        return plan_df.write_stream(self.ck, output_mode=mode,
+                                    sink_path=self.sink)
+
+
+def _norm(shape, pdf):
+    if pdf is None or not len(pdf):
+        return pdf
+    key = {"stateful": "g", "event_time": "w"}.get(shape)
+    if key is not None and key in pdf.columns:
+        return pdf.sort_values(key).reset_index(drop=True)
+    return pdf.reset_index(drop=True)
+
+
+# -- the crash matrix -------------------------------------------------------
+
+
+@pytest.mark.parametrize("source", ["memory", "file"])
+@pytest.mark.parametrize("shape", SHAPES)
+def test_crash_matrix(session, tmp_path, shape, source):
+    base = str(tmp_path)
+    # uninterrupted baseline: 3 feed rounds, one query start to finish
+    fb = _Feeder(session, shape, source, base, "base")
+    qb = fb.query()
+    for _ in range(3):
+        fb.feed()
+        qb.process_available()
+    want_concat = pd.concat(qb.results(), ignore_index=True)
+    want_final = _norm(shape, qb.latest())
+    want_sink = _norm(shape, read_sink(fb.sink))
+
+    for seam in SEAMS:
+        f = _Feeder(session, shape, source, base, seam)
+        q = f.query()
+        f.feed()
+        q.process_available()  # batch 0 commits clean
+        f.feed()
+        fired = False
+        with faults.inject(session.conf, f"{seam}:fatal:1") as fp:
+            try:
+                q.process_available()  # crash mid-batch-1
+            except faults.FaultInjected:
+                fired = True
+        # stateless queries have no state commit; every other
+        # (seam, shape) must actually crash or the cell is vacuous
+        expect_fire = not (seam == "stream_state_commit"
+                           and shape == "stateless")
+        assert fired == expect_fire, (shape, source, seam,
+                                      fp.fired_log)
+        survivors = dict(q._sink_results)
+        del q  # the hard crash: the query object is GONE
+        f.feed()
+        q2 = f.query()  # fresh query over the same checkpoint
+        q2.process_available()
+        combined = dict(survivors)
+        combined.update(q2._sink_results)
+        cell = f"{shape}/{source}/{seam}"
+        try:
+            if shape == "stateless":
+                got = pd.concat([combined[k] for k in sorted(combined)],
+                                ignore_index=True)
+                pd.testing.assert_frame_equal(got, want_concat)
+            else:
+                got_final = _norm(shape, combined[max(combined)])
+                pd.testing.assert_frame_equal(got_final, want_final)
+            # the file sink saw the same crash: manifested rows must
+            # be byte-identical to the uninterrupted run's
+            got_sink = _norm(shape, read_sink(f.sink))
+            pd.testing.assert_frame_equal(
+                got_sink.sort_values(list(got_sink.columns))
+                .reset_index(drop=True),
+                want_sink.sort_values(list(want_sink.columns))
+                .reset_index(drop=True))
+        except AssertionError as e:
+            raise AssertionError(f"crash-matrix cell {cell}: {e}") from e
+
+
+def test_same_object_retry_after_commit_crash(session, tmp_path):
+    """Replay-duplication regression (in-process flavor): a crash
+    between sink emit and commit-log write, retried on the SAME query
+    object, must REPLACE the batch's sink entry — the memory sink is
+    keyed by batch id, the file sink by its manifest — never append a
+    duplicate."""
+    ck, sink = str(tmp_path / "ck"), str(tmp_path / "sink")
+    src = MemoryStream(session, _schema_df("stateless"))
+    q = (src.to_df().filter(col("v") >= 0)
+         .write_stream(ck, output_mode="append", sink_path=sink))
+    src.add_data(_round_df("stateless", 0))
+    q.process_available()
+    src.add_data(_round_df("stateless", 1))
+
+    def boom(batch_id, payload):
+        raise RuntimeError("simulated commit-log write crash")
+
+    q.commit_log.add = boom  # instance shadow
+    with pytest.raises(RuntimeError, match="commit-log write crash"):
+        q.process_available()
+    del q.commit_log.add  # heal
+    q.process_available()  # same-object retry replays batch 1
+    assert sorted(q._sink_results) == [0, 1]
+    want = pd.concat([_round_df("stateless", 0),
+                      _round_df("stateless", 1)], ignore_index=True)
+    got = pd.concat(q.results(), ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+    # file sink: the replayed batch overwrote its own part — the
+    # manifested row multiset equals the uninterrupted run's
+    got_sink = read_sink(sink).sort_values(["k", "v"]) \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got_sink, want.sort_values(["k", "v"]).reset_index(drop=True))
+
+
+def test_stateful_crash_between_offset_and_commit_restart(session,
+                                                          tmp_path):
+    """The satellite's cross-process flavor: offset written, commit
+    missing, STATEFUL batch — the restart must re-run the logged range
+    against the committed state version, landing on the same totals as
+    an uninterrupted run (no double-fold)."""
+    ck = str(tmp_path / "ck")
+    src = MemoryStream(session, _schema_df("stateful"))
+
+    def build():
+        return (src.to_df()
+                .group_by(F.pmod(col("k"), 5).alias("g"))
+                .agg(F.sum(col("v")).alias("s"))
+                .write_stream(ck))
+
+    q = build()
+    src.add_data(_round_df("stateful", 0))
+    q.process_available()
+    src.add_data(_round_df("stateful", 1))
+    with faults.inject(session.conf, "stream_sink_emit:fatal:1"):
+        with pytest.raises(faults.FaultInjected):
+            q.process_available()  # state v1 written, commit missing
+    del q
+    q2 = build()
+    q2.process_available()
+    # uninterrupted twin
+    src2 = MemoryStream(session, _schema_df("stateful"))
+    q3 = (src2.to_df().group_by(F.pmod(col("k"), 5).alias("g"))
+          .agg(F.sum(col("v")).alias("s"))
+          .write_stream(str(tmp_path / "ck2")))
+    src2.add_data(_round_df("stateful", 0))
+    src2.add_data(_round_df("stateful", 1))
+    q3.process_available()
+    pd.testing.assert_frame_equal(
+        q2.latest().sort_values("g").reset_index(drop=True),
+        q3.latest().sort_values("g").reset_index(drop=True))
+
+
+# -- incremental state store (unit) -----------------------------------------
+
+
+def _rand_tables(rng, n=64):
+    return {"cnt": rng.randint(0, 5, n).astype(np.int64),
+            "acc_0_0": rng.randint(-100, 100, n).astype(np.int64),
+            "acc_1_0": rng.rand(n)}
+
+
+def test_state_store_delta_snapshot_restore(tmp_path):
+    conf = Conf().set(
+        "spark_tpu.streaming.stateStore.snapshotEveryDeltas", 10)
+    store = StateStore(str(tmp_path / "st"), conf)
+    rng = np.random.RandomState(7)
+    state = _rand_tables(rng)
+    prev = None
+    per_version = {}
+    for v in range(13):
+        if v:
+            # mutate a few groups only (the delta shape)
+            idx = rng.choice(64, 5, replace=False)
+            state = {k: a.copy() for k, a in state.items()}
+            state["acc_0_0"][idx] += 1
+            state["cnt"][idx] += 1
+        info = store.commit_tables(v, state, prev)
+        per_version[v] = {k: a.copy() for k, a in state.items()}
+        prev = state
+        want_kind = "snapshot" if v in (0, 10) else "delta"
+        assert info["kind"] == want_kind, (v, info)
+        if want_kind == "delta":
+            assert info["changed"] <= 5 + 5  # cnt+acc share groups
+    # restore from snapshot + deltas byte-identical to the full state
+    for v in (0, 3, 9, 10, 12):
+        got = store.load_tables(v)
+        for k, want in per_version[v].items():
+            np.testing.assert_array_equal(got[k], want, err_msg=f"v{v}/{k}")
+    assert store.last_restore_replayed <= 10
+    got12 = store.load_tables(12)
+    assert store.last_restore_replayed == 2  # snapshot 10 + 2 deltas
+
+
+def test_state_store_nan_slots_not_flagged_changed(tmp_path):
+    conf = Conf()
+    store = StateStore(str(tmp_path / "st"), conf)
+    a = {"cnt": np.array([1, 0, 2], np.int64),
+         "acc_0_0": np.array([1.0, np.nan, 3.0])}
+    store.commit_tables(0, a, None)
+    b = {"cnt": np.array([2, 0, 2], np.int64),
+         "acc_0_0": np.array([5.0, np.nan, 3.0])}
+    info = store.commit_tables(1, b, a)
+    assert info["kind"] == "delta" and info["changed"] == 1, info
+    got = store.load_tables(1)
+    np.testing.assert_array_equal(got["cnt"], b["cnt"])
+    assert np.isnan(got["acc_0_0"][1]) and got["acc_0_0"][0] == 5.0
+
+
+def test_state_store_prune_never_breaks_restore(tmp_path):
+    """Compaction safety: pruning at every commit never deletes a file
+    the last committed version's restore chain needs."""
+    conf = Conf().set(
+        "spark_tpu.streaming.stateStore.snapshotEveryDeltas", 4)
+    store = StateStore(str(tmp_path / "st"), conf)
+    rng = np.random.RandomState(3)
+    state = _rand_tables(rng, 16)
+    prev = None
+    for v in range(23):
+        if v:
+            state = {k: a.copy() for k, a in state.items()}
+            state["cnt"][rng.randint(0, 16)] += 1
+        store.commit_tables(v, state, prev)
+        prev = state
+        store.prune(v, retain=2)
+        got = store.load_tables(v)  # restore after every compaction
+        for k in state:
+            np.testing.assert_array_equal(got[k], state[k])
+        assert store.last_restore_replayed < 4
+    # compaction actually retired files (not a no-op)
+    assert min(store.snapshot_versions()) >= 16
+    assert min(store.delta_versions()) > min(store.snapshot_versions())
+
+
+def test_state_store_frame_delta_tombstones(tmp_path):
+    conf = Conf().set(
+        "spark_tpu.streaming.stateStore.snapshotEveryDeltas", 10)
+    store = StateStore(str(tmp_path / "st"), conf)
+    s0 = pd.DataFrame({"w": [0, 10, 20], "acc": [1.0, 2.0, 3.0]})
+    store.commit_frame(0, s0, None, ["w"])
+    # v1: update w=10, evict w=0, add w=30
+    s1 = pd.DataFrame({"w": [10, 20, 30], "acc": [5.0, 3.0, 7.0]})
+    info = store.commit_frame(1, s1, s0, ["w"])
+    assert info["kind"] == "delta" and info["changed"] == 2, info
+    got = store.load_frame(1).sort_values("w").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, s1.sort_values("w").reset_index(drop=True))
+    # v2: no change at all -> empty delta
+    info2 = store.commit_frame(2, s1, s1, ["w"])
+    assert info2["kind"] == "delta" and info2["changed"] == 0
+    got2 = store.load_frame(2).sort_values("w").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got2, s1.sort_values("w").reset_index(drop=True))
+
+
+def test_incremental_delta_ratio_and_bounded_restore(session, tmp_path):
+    """The incremental-checkpointing acceptance: with ~6% of groups
+    changing per trigger, steady-state delta bytes stay <= 25% of the
+    snapshot bytes, and a fresh query's restore replays at most
+    snapshotEveryDeltas deltas."""
+    ck = str(tmp_path / "ck")
+    records = []
+
+    class _Cap:
+        def on_streaming_batch(self, event):
+            records.append(event.record)
+
+    cap = _Cap()
+    session.add_listener(cap)
+    try:
+        src = MemoryStream(session, _schema_df("stateful"))
+        q = (src.to_df()
+             .group_by(F.pmod(col("k"), 1024).alias("g"))
+             .agg(F.sum(col("v")).alias("s"))
+             .write_stream(ck))
+        # batch 0 touches EVERY group; batches 1..24 touch 64 (~6%)
+        src.add_data(pd.DataFrame(
+            {"k": np.arange(1024, dtype=np.int64),
+             "v": np.ones(1024, dtype=np.int64)}))
+        q.process_available()
+        for i in range(1, 25):
+            src.add_data(pd.DataFrame(
+                {"k": np.arange(64, dtype=np.int64),
+                 "v": np.full(64, i, dtype=np.int64)}))
+            q.process_available()
+    finally:
+        session.remove_listener(cap)
+    assert len(records) == 25
+    assert records[0]["kind"] == "snapshot"
+    snap_bytes = records[0]["state_bytes"]
+    deltas = [r for r in records[1:] if r["kind"] == "delta"]
+    snaps = [r for r in records if r["kind"] == "snapshot"]
+    assert [r["batch_id"] for r in snaps] == [0, 10, 20]
+    assert deltas, records
+    steady = max(r["state_bytes"] for r in deltas)
+    assert steady <= 0.25 * snap_bytes, (steady, snap_bytes)
+    # fresh query restore: newest snapshot (20) + at most
+    # snapshotEveryDeltas deltas
+    q2 = (src.to_df()
+          .group_by(F.pmod(col("k"), 1024).alias("g"))
+          .agg(F.sum(col("v")).alias("s"))
+          .write_stream(ck))
+    assert q2._store.last_restore_replayed == 24 - 20
+    assert q2._store.last_restore_replayed <= 10
+    # the restored state is live: one more batch lands on exact totals
+    src.add_data(pd.DataFrame({"k": np.array([0], dtype=np.int64),
+                               "v": np.array([1000], dtype=np.int64)}))
+    q2.process_available()
+    out = q2.latest().set_index("g")["s"]
+    assert out.loc[0] == 1 + sum(range(1, 25)) + 1000
+    assert out.loc[100] == 1  # untouched group carried intact
+
+
+# -- metadata-log durability ------------------------------------------------
+
+
+def test_metadata_log_latest_skips_torn_and_empty(tmp_path, session):
+    m = session.metrics
+    c0 = m.counter("streaming_log_corrupt").value
+    log = _MetadataLog(str(tmp_path / "log"), metrics=m)
+    log.add(0, {"start": 0, "end": 1})
+    log.add(1, {"start": 1, "end": 2})
+    # torn newest entry: truncated mid-JSON
+    with open(os.path.join(log.path, "2"), "w") as f:
+        f.write('{"start": 2, "e')
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        i, payload = log.latest()
+    assert (i, payload) == (1, {"start": 1, "end": 2})
+    # empty newest entry (crash before any byte flushed)
+    open(os.path.join(log.path, "3"), "w").close()
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        i, payload = log.latest()
+    assert i == 1
+    assert m.counter("streaming_log_corrupt").value >= c0 + 3
+    # no tmp litter from the fsync'd add path
+    assert not [f for f in os.listdir(log.path) if f.endswith(".tmp")]
+
+
+def test_metadata_log_all_corrupt_returns_none(tmp_path, session):
+    log = _MetadataLog(str(tmp_path / "log"), metrics=session.metrics)
+    open(os.path.join(log.path, "0"), "w").close()
+    with pytest.warns(UserWarning):
+        assert log.latest() == (None, None)
+
+
+def test_recovery_survives_torn_commit_entry(session, tmp_path):
+    """A torn newest COMMIT entry falls back one version: the restart
+    re-runs the batch it covered (idempotent) instead of crashing the
+    whole recovery."""
+    ck = str(tmp_path / "ck")
+    src = MemoryStream(session, _schema_df("stateful"))
+
+    def build():
+        return (src.to_df()
+                .group_by(F.pmod(col("k"), 5).alias("g"))
+                .agg(F.sum(col("v")).alias("s")).write_stream(ck))
+
+    q = build()
+    for i in range(2):
+        src.add_data(_round_df("stateful", i))
+        q.process_available()
+    want = q.latest().sort_values("g").reset_index(drop=True)
+    # tear the newest commit entry
+    with open(os.path.join(ck, "commits", "1"), "w") as f:
+        f.write('{"ok": tru')
+    del q
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        q2 = build()
+    assert q2._committed_batch == 0  # fell back one version
+    q2.process_available()  # replays batch 1 from its logged range
+    pd.testing.assert_frame_equal(
+        q2.latest().sort_values("g").reset_index(drop=True), want)
+
+
+def test_recovery_survives_torn_offset_entry_with_intact_commit(
+        session, tmp_path):
+    """Asymmetric corruption: the newest OFFSET entry torn while its
+    COMMIT entry survived. Falling back one offset entry used to
+    re-plan (and double-fold) the committed batch's range; the commit
+    entry's `end` watermark now floors the next planned range."""
+    ck = str(tmp_path / "ck")
+    src = MemoryStream(session, _schema_df("stateful"))
+
+    def build():
+        return (src.to_df()
+                .group_by(F.pmod(col("k"), 5).alias("g"))
+                .agg(F.sum(col("v")).alias("s")).write_stream(ck))
+
+    q = build()
+    for i in range(2):
+        src.add_data(_round_df("stateful", i))
+        q.process_available()
+    del q
+    # tear the newest offset entry; its commit survives
+    with open(os.path.join(ck, "offsets", "1"), "w") as f:
+        f.write('{"start": 1, "e')
+    src.add_data(_round_df("stateful", 2))
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        q2 = build()
+        q2.process_available()
+    # uninterrupted twin proves no range was folded twice
+    src3 = MemoryStream(session, _schema_df("stateful"))
+    q3 = (src3.to_df().group_by(F.pmod(col("k"), 5).alias("g"))
+          .agg(F.sum(col("v")).alias("s"))
+          .write_stream(str(tmp_path / "ck3")))
+    for i in range(3):
+        src3.add_data(_round_df("stateful", i))
+    q3.process_available()
+    pd.testing.assert_frame_equal(
+        q2.latest().sort_values("g").reset_index(drop=True),
+        q3.latest().sort_values("g").reset_index(drop=True))
+
+
+def test_file_source_heals_torn_seen_log_tail(session, tmp_path):
+    """A torn seen-file-log tail below a PLANNED offset range must not
+    silently drop the lost files' rows: re-discovery appends them back
+    at their original indices (deterministic (mtime, name) order) and
+    the replayed batch covers the full planned range."""
+    src_dir = str(tmp_path / "src")
+    ck = str(tmp_path / "ck")
+    os.makedirs(src_dir)
+    for i in range(3):
+        _round_df("stateless", i).to_parquet(
+            os.path.join(src_dir, f"r{i}.parquet"))
+
+    def build():
+        s = FileStreamSource(session, src_dir,
+                             schema_df=_schema_df("stateless"))
+        return (s.to_df().filter(col("v") >= 0)
+                .write_stream(ck, output_mode="append"))
+
+    q = build()
+    q.process_available()  # batch 0 covers files [0, 3)
+    assert len(q.results()) == 1 and len(q.results()[0]) == 18
+    del q
+    # simulate the torn tail: the newest seen-log entry is corrupt, so
+    # a planned-but-uncommitted batch range exceeds the reloaded log
+    with open(os.path.join(ck, "sources", "0", "2"), "w") as f:
+        f.write('{"name": "r2.par')
+    os.remove(os.path.join(ck, "commits", "0"))  # batch 0 uncommitted
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        q2 = build()
+    q2.process_available()  # replays [0, 3) — healed, nothing lost
+    got = pd.concat(q2.results(), ignore_index=True)
+    want = pd.concat([_round_df("stateless", i) for i in range(3)],
+                     ignore_index=True)
+    pd.testing.assert_frame_equal(
+        got.sort_values(["k", "v"]).reset_index(drop=True),
+        want.sort_values(["k", "v"]).reset_index(drop=True))
+    assert len(q2.stream._seen) == 3
+    assert [e["name"] for e in q2.stream._seen] == \
+        ["r0.parquet", "r1.parquet", "r2.parquet"]
+
+
+def test_file_source_vanished_planned_file_fails_loudly(session,
+                                                        tmp_path):
+    """Files covered by a planned batch that are GONE from the
+    directory (not just a torn log entry) cannot be replayed
+    exactly-once — the batch must raise, not silently skip them."""
+    src_dir = str(tmp_path / "src")
+    ck = str(tmp_path / "ck")
+    os.makedirs(src_dir)
+    for i in range(2):
+        _round_df("stateless", i).to_parquet(
+            os.path.join(src_dir, f"r{i}.parquet"))
+
+    def build():
+        s = FileStreamSource(session, src_dir,
+                             schema_df=_schema_df("stateless"))
+        return s, (s.to_df().filter(col("v") >= 0)
+                   .write_stream(ck, output_mode="append"))
+
+    _, q = build()
+    q.process_available()
+    del q
+    # lose the seen-log tail AND the file itself
+    with open(os.path.join(ck, "sources", "0", "1"), "w") as f:
+        f.write("")
+    os.remove(os.path.join(ck, "commits", "0"))
+    os.remove(os.path.join(src_dir, "r1.parquet"))
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        _, q2 = build()
+    with pytest.raises(RuntimeError, match="planned batch vanished"):
+        q2.process_available()
+
+
+# -- file source: quarantine ------------------------------------------------
+
+
+def _write_corrupt(path):
+    with open(path, "wb") as f:
+        f.write(b"these bytes are not a parquet file")
+
+
+def test_file_source_quarantines_corrupt_file(session, tmp_path):
+    src_dir = str(tmp_path / "src")
+    os.makedirs(src_dir)
+    _round_df("stateless", 0).to_parquet(
+        os.path.join(src_dir, "a.parquet"))
+    _write_corrupt(os.path.join(src_dir, "b.parquet"))
+    _round_df("stateless", 1).to_parquet(
+        os.path.join(src_dir, "c.parquet"))
+    q0 = session.metrics.counter("streaming_files_quarantined").value
+    src = FileStreamSource(session, src_dir,
+                           schema_df=_schema_df("stateless"))
+    q = (src.to_df().filter(col("v") >= 0)
+         .write_stream(str(tmp_path / "ck"), output_mode="append"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        q.process_available()
+    got = pd.concat(q.results(), ignore_index=True)
+    want = pd.concat([_round_df("stateless", 0),
+                      _round_df("stateless", 1)], ignore_index=True)
+    pd.testing.assert_frame_equal(
+        got.sort_values(["k", "v"]).reset_index(drop=True),
+        want.sort_values(["k", "v"]).reset_index(drop=True))
+    assert session.metrics.counter(
+        "streaming_files_quarantined").value == q0 + 1
+    quar = src.quarantined()
+    assert len(quar) == 1 and quar[0]["name"] == "b.parquet"
+    # the quarantine is IN the seen log: a fresh query over the same
+    # checkpoint skips the file without re-decoding (and without
+    # re-counting)
+    src2 = FileStreamSource(session, src_dir,
+                            schema_df=_schema_df("stateless"))
+    q2 = (src2.to_df().filter(col("v") >= 0)
+          .write_stream(str(tmp_path / "ck"), output_mode="append"))
+    q2.process_available()  # drained: nothing new
+    assert len(src2.quarantined()) == 1
+    assert session.metrics.counter(
+        "streaming_files_quarantined").value == q0 + 1
+
+
+def test_file_source_strict_mode_fails_batch(session, tmp_path):
+    src_dir = str(tmp_path / "src")
+    os.makedirs(src_dir)
+    _write_corrupt(os.path.join(src_dir, "bad.parquet"))
+    session.conf.set("spark_tpu.streaming.source.file.strict", True)
+    src = FileStreamSource(session, src_dir,
+                           schema_df=_schema_df("stateless"))
+    q = (src.to_df().filter(col("v") >= 0)
+         .write_stream(str(tmp_path / "ck"), output_mode="append"))
+    with pytest.raises(RuntimeError, match="strict"):
+        q.process_available()
+
+
+def test_file_source_schema_mismatch_quarantines(session, tmp_path):
+    src_dir = str(tmp_path / "src")
+    os.makedirs(src_dir)
+    pd.DataFrame({"other": [1.5]}).to_parquet(
+        os.path.join(src_dir, "wrong.parquet"))
+    _round_df("stateless", 0).to_parquet(
+        os.path.join(src_dir, "right.parquet"))
+    src = FileStreamSource(session, src_dir,
+                           schema_df=_schema_df("stateless"))
+    q = (src.to_df().filter(col("v") >= 0)
+         .write_stream(str(tmp_path / "ck"), output_mode="append"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        q.process_available()
+    got = pd.concat(q.results(), ignore_index=True)
+    assert len(got) == len(_round_df("stateless", 0))
+
+
+def test_file_source_ignores_metadata_and_tmp_names(session, tmp_path):
+    src_dir = str(tmp_path / "src")
+    os.makedirs(os.path.join(src_dir, "_metadata"))
+    _round_df("stateless", 0).to_parquet(
+        os.path.join(src_dir, "data.parquet"))
+    _round_df("stateless", 1).to_parquet(
+        os.path.join(src_dir, "inflight.parquet.tmp"))
+    with open(os.path.join(src_dir, "_SUCCESS"), "w"):
+        pass
+    src = FileStreamSource(session, src_dir,
+                           schema_df=_schema_df("stateless"))
+    assert src.latest_offset() == 1
+    assert src._seen[0]["name"] == "data.parquet"
+
+
+# -- file sink: manifest atomicity ------------------------------------------
+
+
+def test_file_sink_reader_ignores_unmanifested_parts(session, tmp_path):
+    sink = str(tmp_path / "sink")
+    src = MemoryStream(session, _schema_df("stateless"))
+    q = (src.to_df().filter(col("v") >= 0)
+         .write_stream(str(tmp_path / "ck"), output_mode="append",
+                       sink_path=sink))
+    for i in range(2):
+        src.add_data(_round_df("stateless", i))
+        q.process_available()
+    want = read_sink(sink)
+    # an orphaned part (its batch never manifested) is invisible
+    pd.DataFrame({"k": [999], "v": [999]}).to_parquet(
+        os.path.join(sink, "part-09999.parquet"))
+    pd.testing.assert_frame_equal(read_sink(sink), want)
+    assert 999 not in read_sink(sink)["k"].values
+    # a torn manifest entry is skipped with a warning, not fatal
+    with open(os.path.join(sink, "_metadata", "7"), "w") as f:
+        f.write('{"parts": ["part-0')
+    with pytest.warns(UserWarning, match="corrupt metadata log"):
+        pd.testing.assert_frame_equal(read_sink(sink), want)
+
+
+def test_file_sink_complete_mode_reads_latest_batch(session, tmp_path):
+    sink = str(tmp_path / "sink")
+    src = MemoryStream(session, _schema_df("stateful"))
+    q = (src.to_df().group_by(F.pmod(col("k"), 5).alias("g"))
+         .agg(F.sum(col("v")).alias("s"))
+         .write_stream(str(tmp_path / "ck"), sink_path=sink))
+    for i in range(3):
+        src.add_data(_round_df("stateful", i))
+        q.process_available()
+    got = read_sink(sink).sort_values("g").reset_index(drop=True)
+    want = q.latest().sort_values("g").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_file_sink_complete_mode_prunes_superseded_parts(session,
+                                                         tmp_path):
+    """Complete mode rewrites the full result every batch: parts
+    outside the retention window are dead and must be GC'd (a
+    long-running stream must not fill the disk), while append-mode
+    parts are the data and stay."""
+    sink = str(tmp_path / "sink")
+    src = MemoryStream(session, _schema_df("stateful"))
+    q = (src.to_df().group_by(F.pmod(col("k"), 5).alias("g"))
+         .agg(F.sum(col("v")).alias("s"))
+         .write_stream(str(tmp_path / "ck"), sink_path=sink))
+    for i in range(6):
+        src.add_data(_round_df("stateful", i))
+        q.process_available()
+    parts = [f for f in os.listdir(sink) if f.endswith(".parquet")]
+    # retainBatches=2: only batches >= committed-2 survive
+    assert sorted(parts) == ["part-00003.parquet", "part-00004.parquet",
+                             "part-00005.parquet"], parts
+    got = read_sink(sink).sort_values("g").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, q.latest().sort_values("g").reset_index(drop=True))
+    # append mode: nothing pruned
+    sink2 = str(tmp_path / "sink2")
+    src2 = MemoryStream(session, _schema_df("stateless"))
+    q2 = (src2.to_df().filter(col("v") >= 0)
+          .write_stream(str(tmp_path / "ck2"), output_mode="append",
+                        sink_path=sink2))
+    for i in range(6):
+        src2.add_data(_round_df("stateless", i))
+        q2.process_available()
+    parts2 = [f for f in os.listdir(sink2) if f.endswith(".parquet")]
+    assert len(parts2) == 6, parts2
+
+
+def test_file_sink_replay_overwrites_own_parts(session, tmp_path):
+    sink = str(tmp_path / "sink")
+    fs = FileStreamSink(session, sink, "append")
+    fs.emit(0, pd.DataFrame({"k": [1], "v": [10]}))
+    fs.emit(1, pd.DataFrame({"k": [2], "v": [20]}))
+    # replay of batch 1 (crash between emit and commit): overwrite
+    fs.emit(1, pd.DataFrame({"k": [2], "v": [20]}))
+    got = read_sink(sink).sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, pd.DataFrame({"k": [1, 2], "v": [10, 20]}))
+
+
+# -- observability: streaming record + summary + validator ------------------
+
+
+def test_streaming_event_log_record_and_summary(session, tmp_path):
+    from spark_tpu import history
+    ev_dir = str(tmp_path / "events")
+    session.conf.set("spark_tpu.sql.eventLog.dir", ev_dir)
+    src = MemoryStream(session, _schema_df("stateful"))
+    q = (src.to_df().group_by(F.pmod(col("k"), 5).alias("g"))
+         .agg(F.sum(col("v")).alias("s"))
+         .write_stream(str(tmp_path / "ck"),
+                       sink_path=str(tmp_path / "sink")))
+    src.add_data(_round_df("stateful", 0))
+    q.process_available()
+    # second batch touches ONE group: a genuine (small) delta
+    src.add_data(pd.DataFrame({"k": np.array([0], dtype=np.int64),
+                               "v": np.array([7], dtype=np.int64)}))
+    q.process_available()
+    session.conf.set("spark_tpu.sql.eventLog.dir", "")
+    events = history.read_event_log(ev_dir)
+    ss = history.streaming_summary(events)
+    assert len(ss) == 2, ss
+    assert ss["kind"].tolist() == ["snapshot", "delta"]
+    assert (ss["state_bytes"] > 0).all()
+    assert ss["batch_id"].tolist() == [0, 1]
+    assert (ss["sink_parts"] == 1).all()
+    assert (ss["quarantined"] == 0).all()
+    assert (ss["source"] == "memory").all()
+    # the versioned-schema validator accepts the v4 lines
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "events_tool", os.path.join(root, "scripts", "events_tool.py"))
+    et = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(et)
+    assert et.validate([ev_dir]) == []
+    # and rejects a pre-v4 line smuggling a streaming record
+    bad = {"schema_version": 3, "query_id": 1, "ts": 1.0,
+           "status": "ok", "plan": "x", "streaming": {"batch_id": 0}}
+    bad_path = os.path.join(ev_dir, "app-bad.jsonl")
+    with open(bad_path, "w") as f:
+        f.write(json.dumps(bad) + "\n")
+    problems = et.validate([bad_path])
+    assert any("v4 field 'streaming'" in p for p in problems), problems
+
+
+def test_streaming_metrics_counters(session, tmp_path):
+    m = session.metrics
+    b0 = m.counter("streaming_batches").value
+    r0 = m.counter("streaming_rows").value
+    d0 = m.counter("streaming_state_delta_bytes").value
+    s0 = m.counter("streaming_state_snapshot_bytes").value
+    src = MemoryStream(session, _schema_df("stateful"))
+    ck = str(tmp_path / "ck")
+
+    def build():
+        return (src.to_df().group_by(F.pmod(col("k"), 5).alias("g"))
+                .agg(F.sum(col("v")).alias("s")).write_stream(ck))
+
+    q = build()
+    src.add_data(_round_df("stateful", 0))
+    q.process_available()
+    for i in range(2):
+        # partial churn: one group per batch -> deltas, not snapshots
+        src.add_data(pd.DataFrame(
+            {"k": np.array([i], dtype=np.int64),
+             "v": np.array([6], dtype=np.int64)}))
+        q.process_available()
+    assert m.counter("streaming_batches").value == b0 + 3
+    assert m.counter("streaming_rows").value == r0 + 8
+    assert m.counter("streaming_state_snapshot_bytes").value > s0
+    assert m.counter("streaming_state_delta_bytes").value > d0
+    # restore wall-clock ticks on a fresh query over the checkpoint
+    t0 = m.counter("streaming_restore_ms").value
+    build()
+    assert m.counter("streaming_restore_ms").value > t0
