@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Eleven stages, all mandatory:
+# Twelve stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -61,9 +61,16 @@
 #      must recover to output byte-identical to an uninterrupted run
 #      (incremental state store: delta restore), with the
 #      streaming_batches metric and per-batch event records sane
+#  12. concurrency smoke: the guarded-by + lock-order passes in --json
+#      form must report zero violations (machine-readable gate), and a
+#      concurrent service run (2 sessions x 2 queries, prefetch on)
+#      under the runtime lockwatch must show an observed lock
+#      acquisition order consistent with the static registry ranking,
+#      golden parity per query, and no prefetch daemon outliving its
+#      query
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-10 still run) for
+#   --fast skips the full pytest suite (stages 2-12 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -76,7 +83,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/11: tier-1 test suite --"
+    echo "-- stage 1/12: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -90,16 +97,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/11: SKIPPED (--fast) --"
+    echo "-- stage 1/12: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/11: dryrun_multichip(8) --"
+echo "-- stage 2/12: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/11: bench smoke --"
+echo "-- stage 3/12: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -131,7 +138,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/11: chaos smoke --"
+echo "-- stage 4/12: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -185,7 +192,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/11: observability + analysis smoke --"
+echo "-- stage 5/12: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -278,10 +285,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/11: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/12: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/11: SQL service smoke --"
+echo "-- stage 7/12: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -355,7 +362,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/11: join-kernel + ingest parity smoke --"
+echo "-- stage 8/12: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -413,7 +420,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/11: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/12: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -457,7 +464,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/11: elastic mesh smoke --"
+echo "-- stage 10/12: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -507,7 +514,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/11: streaming durability smoke --"
+echo "-- stage 11/12: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -599,5 +606,88 @@ EOF7
 # the streaming event lines validate against the versioned schema
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
+
+echo "-- stage 12/12: concurrency smoke --"
+# (a) the concurrency passes gate machine-readably at zero violations
+env JAX_PLATFORMS=cpu python - <<'EOF8'
+import json
+import subprocess
+import sys
+
+out = subprocess.run(
+    [sys.executable, "scripts/lint.py", "--json", "guarded-by",
+     "lock-order"], capture_output=True, text=True)
+payload = json.loads(out.stdout)
+assert out.returncode == 0 and payload["ok"], payload
+assert payload["violations"] == [], payload["violations"]
+assert any(n.startswith("waiver:") for n in payload["notes"])
+print(json.dumps({"preflight_concurrency_lint": "ok",
+                  "waivers": sum(n.startswith("waiver:")
+                                 for n in payload["notes"])}))
+EOF8
+
+# (b) lockwatch smoke: concurrent service queries with prefetch on —
+# observed lock order must be consistent with the static registry
+# ranking, golden parity per query, no leaked prefetch daemons
+env JAX_PLATFORMS=cpu python - <<'EOF9'
+import json
+import tempfile
+import threading
+
+from spark_tpu import Conf
+from spark_tpu.service.arbiter import install_arbiter
+from spark_tpu.service.server import SqlService
+from spark_tpu.testing.lockwatch import LockWatch
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch import sql_queries as SQLQ
+from spark_tpu.tpch.datagen import write_parquet
+
+path = tempfile.mkdtemp(prefix="preflight_lockwatch_") + "/sf"
+write_parquet(path, 0.001)
+conf = Conf()
+conf.set("spark_tpu.service.port", 0)
+conf.set("spark_tpu.service.hbmBudget", 1 << 30)
+conf.set("spark_tpu.sql.execution.streamingChunkRows", 2048)
+conf.set("spark_tpu.sql.io.deviceCacheBytes", 0)
+svc = SqlService(conf,
+                 init_session=lambda s: Q.register_tables(s, path))
+watch = LockWatch()
+try:
+    for name in ("a", "b"):  # warm the pool, then watch it
+        svc.submit(SQLQ.Q1, session=name)
+    watch.install_service(svc)
+    results, errors = [], []
+
+    def run(name):
+        try:
+            for _ in range(2):
+                results.append(svc.submit(SQLQ.Q1, session=name)[1])
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    ts = [threading.Thread(target=run, args=(n,)) for n in ("a", "b")]
+    [t.start() for t in ts]
+    [t.join(300) for t in ts]
+    # a wedged worker (the deadlock class this stage exists to catch)
+    # must FAIL here, not pass vacuously and hang interpreter exit
+    assert not any(t.is_alive() for t in ts), "query thread wedged"
+    assert not errors, errors
+    assert len(results) == 4, f"expected 4 results, got {len(results)}"
+    want = G.GOLDEN["q1"](path).reset_index(drop=True)
+    for table in results:
+        got = G.normalize_decimals(table.to_pandas())[list(want.columns)]
+        G.compare(got.reset_index(drop=True), want)
+    edges = watch.edges()
+    assert edges, "no lock nesting observed — smoke is vacuous"
+    watch.assert_order_consistent()
+    watch.assert_no_thread_leak()
+finally:
+    watch.uninstall()
+    svc.stop()
+    install_arbiter(None)
+print(json.dumps({"preflight_lockwatch_smoke": "ok",
+                  "observed_edges": len(edges)}))
+EOF9
 
 echo "== preflight PASSED =="

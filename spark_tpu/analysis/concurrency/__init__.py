@@ -1,0 +1,43 @@
+"""Concurrency analyzer: guarded-by lint, lock-order graph, lockwatch.
+
+PRs 6-11 turned the engine into a genuinely multithreaded system —
+HTTP handler threads in the SQL service, per-session worker execution,
+the ingest-prefetch daemon, the listener bus feeding straggler /
+rebalance consumers — and the lock discipline that keeps it correct
+(metrics inc locks, the FaultPlan.fire guard, the device-cache RLock)
+was retrofitted by review-pass hand-audit. This package turns that
+discipline into STATIC CHECKS over one declarative registry
+(registry.py), the same shape the fault-site lint gave chaos seams:
+
+- ``guarded-by`` (guarded.py): every declared shared mutable attribute
+  is written only inside a ``with <declared lock>`` block; every
+  ``threading.Lock/RLock/Condition`` in the engine is registered (with
+  a deadlock-avoidance rank); every lock-owning class fully declares
+  its shared state; ContextVar-backed state is recognized as
+  thread-confined; intentional benign races carry an explicit waiver
+  with a reviewer-visible reason.
+- ``lock-order`` (lockorder.py): the static lock-acquisition graph —
+  lexically nested ``with`` blocks plus resolvable call-graph edges —
+  must be acyclic AND consistent with the ranks declared in the
+  registry (every edge ascends; the ranks ARE the canonical order).
+
+The runtime half lives in ``spark_tpu.testing.lockwatch``: wrapped
+locks record the ACTUAL acquisition order, hold times and contention
+under the concurrent stress test, and assert the observed order is
+consistent with the same registry the static passes prove acyclic.
+
+Known limitation (by design, documented here once): the write-site
+check tracks ``self.<attr>`` targets plus the small set of named
+receivers in ``registry.RECEIVER_NAMES``; a mutation through a local
+alias (``held = self._leases[o]; held[k] = v``) is invisible to it.
+Every such alias site in the tree sits inside the owning lock's
+``with`` block today; lockwatch is the dynamic backstop.
+"""
+
+from .registry import (CONFINED, EXTRA_EDGES, GUARDED_BY, LOCKS,
+                       MODULE_WAIVERS, WAIVERS, kind_of, lock_ids,
+                       rank_of)
+
+__all__ = ["LOCKS", "GUARDED_BY", "WAIVERS", "CONFINED",
+           "MODULE_WAIVERS", "EXTRA_EDGES", "rank_of", "kind_of",
+           "lock_ids"]

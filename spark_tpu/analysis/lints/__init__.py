@@ -29,6 +29,17 @@ Built-in passes (lints/passes.py):
   in the README metric-name reference table (the operator-facing half
   of the metric-prefix registration discipline).
 
+Concurrency passes (analysis/concurrency/lint_passes.py):
+
+- ``guarded-by``: every declared shared mutable attribute is written
+  only under its GUARDED_BY-registered lock; every threading lock in
+  the engine is registered with an acquisition-order rank; waivers
+  for intentional benign races are explicit and reviewer-visible.
+- ``lock-order``: the static lock-acquisition graph (nested `with` +
+  resolvable call-graph edges) is acyclic and every edge ascends in
+  registry rank — the canonical order `testing.lockwatch` asserts at
+  runtime.
+
 Adding a pass: subclass `LintPass`, decorate with `@register_lint`,
 give it `name`, `doc`, optionally override `scope`, implement `check`.
 """
@@ -50,10 +61,22 @@ class LintViolation:
     line: int
     pass_name: str
     message: str
+    #: stable machine-readable finding code (CI gates key on it); a
+    #: pass without per-violation codes inherits its class-level code
+    code: str = ""
+    #: "error" fails the lint; "warn"/"info" are surfaced only (every
+    #: built-in pass emits error — the tree gates at zero errors)
+    severity: str = "error"
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.pass_name}] " \
                f"{self.message}"
+
+    def to_dict(self) -> dict:
+        """The --json shape: pass name, file:line, severity, code."""
+        return {"pass": self.pass_name, "code": self.code,
+                "severity": self.severity, "path": self.path,
+                "line": self.line, "message": self.message}
 
 
 class LintContext:
@@ -61,6 +84,10 @@ class LintContext:
 
     def __init__(self, repo: str = REPO):
         self.repo = repo
+        #: informational lines passes surface next to violations (the
+        #: guarded-by waiver list, lock-order graph size) — printed by
+        #: the CLI and carried in --json, never failing the lint
+        self.notes: List[str] = []
         self._conf_keys: Optional[set] = None
         self._metric_prefixes: Optional[tuple] = None
         self._fault_sites: Optional[tuple] = None
@@ -96,13 +123,18 @@ class LintContext:
 
 
 class LintPass:
-    """One static pass. `check` returns (line, message) pairs for a
-    single parsed file; `finish` (optional) returns whole-tree
-    violations after every file was seen — as (relpath, line, message)
-    triples."""
+    """One static pass. `check` returns (line, message[, code
+    [, severity]]) tuples for a single parsed file; `finish`
+    (optional) returns whole-tree violations after every file was
+    seen — as (relpath, line, message[, code[, severity]]) tuples.
+    Omitted codes default to the pass's class-level `code`; omitted
+    severity to "error" (only error-severity violations fail the
+    lint)."""
 
     name: str = "?"
     doc: str = ""
+    #: default machine-readable code for this pass's violations
+    code: str = ""
 
     def scope(self, relpath: str) -> bool:
         """Whether the pass wants this repo-relative .py file."""
@@ -144,12 +176,16 @@ def _iter_py_files(repo: str):
 
 
 def run_passes(names: Optional[List[str]] = None,
-               repo: str = REPO) -> List[LintViolation]:
+               repo: str = REPO,
+               collect_notes: Optional[List[str]] = None
+               ) -> List[LintViolation]:
     """Run the selected passes (default: all) over the repository.
     Parses each file once; a file that fails to parse is itself a
-    violation (the tree must stay importable)."""
+    violation (the tree must stay importable). `collect_notes`
+    receives the passes' informational lines (waiver lists etc.)."""
     # import for side effect: the built-in passes register on import
     from . import passes as _passes  # noqa: F401
+    from ..concurrency import lint_passes as _cpasses  # noqa: F401
     selected = names or sorted(LINT_PASSES)
     unknown = [n for n in selected if n not in LINT_PASSES]
     if unknown:
@@ -158,6 +194,14 @@ def run_passes(names: Optional[List[str]] = None,
     ctx = LintContext(repo)
     instances = [LINT_PASSES[n]() for n in selected]
     out: List[LintViolation] = []
+
+    def emit(p, relpath, item):
+        line, msg = item[0], item[1]
+        code = item[2] if len(item) > 2 else (p.code or p.name)
+        severity = item[3] if len(item) > 3 else "error"
+        out.append(LintViolation(relpath, line, p.name, msg,
+                                 code=code, severity=severity))
+
     for relpath in _iter_py_files(repo):
         in_scope = [p for p in instances if p.scope(relpath)]
         if not in_scope:
@@ -168,12 +212,15 @@ def run_passes(names: Optional[List[str]] = None,
                 tree = ast.parse(f.read(), filename=path)
         except SyntaxError as e:
             out.append(LintViolation(relpath, e.lineno or 1, "parse",
-                                     f"syntax error: {e.msg}"))
+                                     f"syntax error: {e.msg}",
+                                     code="PARSE"))
             continue
         for p in in_scope:
-            for line, msg in p.check(tree, relpath, ctx):
-                out.append(LintViolation(relpath, line, p.name, msg))
+            for item in p.check(tree, relpath, ctx):
+                emit(p, relpath, item)
     for p in instances:
-        for relpath, line, msg in p.finish(ctx):
-            out.append(LintViolation(relpath, line, p.name, msg))
+        for item in p.finish(ctx):
+            emit(p, item[0], item[1:])
+    if collect_notes is not None:
+        collect_notes.extend(ctx.notes)
     return sorted(out, key=lambda v: (v.path, v.line, v.pass_name))

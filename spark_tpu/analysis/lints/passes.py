@@ -34,6 +34,7 @@ class MetricPrefixPass(LintPass):
     history summary column."""
 
     name = "metric-prefix"
+    code = "MP100"
     doc = "add_metric names use registered METRIC_PREFIXES prefixes"
 
     def check(self, tree, relpath, ctx: LintContext
@@ -78,6 +79,7 @@ class ConfKeyPass(LintPass):
     (the PR-2 `stage_rnu` shape, for configuration)."""
 
     name = "conf-key"
+    code = "CK100"
     doc = "conf-key string literals are registered in config.py"
 
     def scope(self, relpath: str) -> bool:
@@ -134,6 +136,7 @@ class FaultSitePass(LintPass):
     and then never fire — the chaos test silently tests nothing."""
 
     name = "fault-site"
+    code = "FS100"
     doc = "fault sites are declared, wired, and spelled consistently"
 
     def __init__(self):
@@ -229,6 +232,7 @@ class ReadmeMetricsPass(LintPass):
     metric-prefix pass enforces in code)."""
 
     name = "readme-metrics"
+    code = "RM100"
     doc = "every METRIC_PREFIXES entry appears in the README table"
 
     def scope(self, relpath: str) -> bool:
@@ -281,14 +285,21 @@ class TracerLeakPass(LintPass):
     value (or truthiness coercion of device data) inside the trace-time
     modules produces trace-order-dependent identities — dict/set keying
     on them silently misbehaves across retraces. Flag the shapes
-    statically in execution/ and parallel/."""
+    statically in the trace-adjacent packages: execution/ + parallel/
+    (the original scope), plus service/, streaming.py and
+    observability/ — all of which hold device values since the
+    PR-6/8/11 concurrency work (the scope predates them)."""
 
     name = "tracer-leak"
-    doc = "no hash()/bool() of traced values in execution/ + parallel/"
+    code = "TL100"
+    doc = "no hash()/bool() of traced values in trace-time modules"
 
     def scope(self, relpath: str) -> bool:
         return relpath.startswith(("spark_tpu/execution/",
-                                   "spark_tpu/parallel/"))
+                                   "spark_tpu/parallel/",
+                                   "spark_tpu/service/",
+                                   "spark_tpu/observability/")) \
+            or relpath == "spark_tpu/streaming.py"
 
     def check(self, tree, relpath, ctx: LintContext
               ) -> List[Tuple[int, str]]:

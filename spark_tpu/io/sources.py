@@ -572,6 +572,10 @@ class PrefetchChunkIterator:
         # its full queue holding a decoded chunk
         self._stop = threading.Event()
         self._finalizer = weakref.finalize(self, self._stop.set)
+        #: the worker thread, kept so close() can JOIN it (bounded):
+        #: a daemon thread must not outlive its query — the lockwatch
+        #: stress test asserts none does
+        self._thread: "threading.Thread | None" = None
 
     # -- ChunkIterator surface ---------------------------------------------
 
@@ -626,11 +630,12 @@ class PrefetchChunkIterator:
             raise StopIteration
         if not self._started:
             self._started = True
-            threading.Thread(
+            self._thread = threading.Thread(
                 target=self._worker, daemon=True,
                 name="spark-tpu-ingest-prefetch",
                 args=(self._inner._host_next, self._retrier,
-                      self._queue, self._stop, self._chunk)).start()
+                      self._queue, self._stop, self._chunk))
+            self._thread.start()
         t0 = _time.perf_counter()
         kind, payload, decode_s = self._queue.get()
         stall_s = _time.perf_counter() - t0
@@ -647,10 +652,32 @@ class PrefetchChunkIterator:
                 round(max(0.0, decode_s - stall_s) * 1e3, 3))
         return self._inner._to_device(payload)
 
-    def close(self) -> None:
-        """Stop the worker (early-exit consumers: external LIMIT)."""
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop the worker AND join it with a bounded timeout
+        (early-exit consumers: external LIMIT). Setting the stop event
+        alone left the thread parked up to one put-poll interval — and
+        a bug there would strand it invisibly; joining makes "no
+        daemon thread outlives its query" an enforced contract (the
+        lockwatch stress test asserts it). The queue is drained first
+        so a worker blocked mid-put unblocks immediately instead of
+        riding out its 0.1s poll."""
         self._closed = True
         self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            import queue as _queue
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            t.join(timeout_s)
+            if t.is_alive():
+                import warnings
+                warnings.warn(
+                    f"ingest-prefetch worker failed to exit within "
+                    f"{timeout_s}s of close()")
+        self._thread = None
 
 
 # ---------------------------------------------------------------------------

@@ -200,37 +200,51 @@ class QueryListener:
 
 
 class ListenerBus:
-    """Synchronous delivery to registered listeners, failure-isolated."""
+    """Synchronous delivery to registered listeners, failure-isolated.
+
+    Lock-guarded (GUARDED_BY: obs.bus): the SQL service posts from
+    concurrent worker threads while tests and the service (un)register
+    listeners — the listener list and the drop counter are shared
+    read-modify-write state. Delivery runs OUTSIDE the lock over a
+    snapshot: listeners take their own locks (straggler monitor,
+    event-log writer), and holding the bus lock across them would
+    invert the registry's lock-order ranking."""
 
     def __init__(self):
+        import threading
+        self._lock = threading.Lock()
         self._listeners: List[QueryListener] = []
         #: callbacks dropped because a listener raised
         self.dropped = 0
 
     def register(self, listener: QueryListener) -> None:
-        if listener not in self._listeners:
-            self._listeners.append(listener)
+        with self._lock:
+            if listener not in self._listeners:
+                self._listeners.append(listener)
 
     def unregister(self, listener: QueryListener) -> None:
-        if listener in self._listeners:
-            self._listeners.remove(listener)
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
 
     @property
     def listeners(self) -> List[QueryListener]:
-        return list(self._listeners)
+        with self._lock:
+            return list(self._listeners)
 
     def post(self, callback: str, event) -> None:
         assert callback in CALLBACKS, callback
         # snapshot: service threads may (un)register listeners while
         # another thread's query is mid-post
-        for listener in list(self._listeners):
+        for listener in self.listeners:
             fn = getattr(listener, callback, None)
             if fn is None:
                 continue
             try:
                 fn(event)
             except Exception as e:  # noqa: BLE001 — never fail the query
-                self.dropped += 1
+                with self._lock:
+                    self.dropped += 1
                 warnings.warn(
                     f"query listener {type(listener).__name__}.{callback} "
                     f"raised (dropped): {type(e).__name__}: {e}")

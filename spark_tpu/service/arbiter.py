@@ -81,7 +81,11 @@ class DeviceResourceArbiter:
         #: can't reclaim bytes a running query still references
         self._pins: Dict[_Owner, set] = {}
         #: sessions-shared compiled-stage cache (the Janino-cache seat;
-        #: pooled sessions all point their _stage_cache here)
+        #: pooled sessions all point their _stage_cache here).
+        #: Deliberately unlocked (guarded-by waiver): dict get/set are
+        #: GIL-atomic and keys are deterministic content hashes, so
+        #: the worst concurrent-fill race is a duplicate compile whose
+        #: last write wins with an equivalent value.
         self.stage_cache: Dict[str, object] = {}
         #: arbiter-owned plan-fingerprint result cache (pooled sessions
         #: all point their _data_cache here)

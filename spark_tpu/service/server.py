@@ -144,9 +144,18 @@ class SqlService:
         #: accumulating one blocked thread per request
         self._async_inflight = 0
         self._async_lock = threading.Lock()
+        #: serializes lazy arbiter installation: two first-submits
+        #: racing _ensure_arbiter could both observe "not installed"
+        #: and one would leak _installed_arbiter=True over the other's
+        #: install (stop() would then uninstall an arbiter a second
+        #: service had installed meanwhile)
+        self._install_lock = threading.Lock()
         self._record_bound = int(self.conf.get(QUERY_LOG_KEY))
         self._seq = 0
         self._started_ts = time.time()
+        # lifecycle attrs (guarded-by waiver): written only by the
+        # owning control thread in start()/stop(), not on the request
+        # path
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
 
@@ -207,11 +216,14 @@ class SqlService:
     def _ensure_arbiter(self) -> None:
         """Install the shared arbiter (when service.hbmBudget > 0) on
         first use — submit() must arbitrate HBM whether the service is
-        embedded or start()ed; stop() uninstalls what we installed."""
-        if (not self._installed_arbiter and self.arbiter.total > 0
-                and get_arbiter() is None):
-            install_arbiter(self.arbiter)
-            self._installed_arbiter = True
+        embedded or start()ed; stop() uninstalls what we installed.
+        Lock-guarded: concurrent first submissions must resolve to
+        exactly one install (and one owner for stop() to undo)."""
+        with self._install_lock:
+            if (not self._installed_arbiter and self.arbiter.total > 0
+                    and get_arbiter() is None):
+                install_arbiter(self.arbiter)
+                self._installed_arbiter = True
 
     def _lock_session(self, entry, session: str, query_id: str) -> None:
         """Lease the named session (its execution is serialized),
@@ -318,21 +330,28 @@ class SqlService:
         record = self._new_record(sql, session)
         bound = (self.admission.max_concurrent
                  + self.admission.queue_depth)
+        # the bound check-and-increment is the only atomic part; the
+        # rejection bookkeeping runs OUTSIDE the lock — _post takes
+        # _records_lock, and holding _async_lock across it inverted
+        # the registry's lock-order ranking (lock-order lint LO202)
         with self._async_lock:
-            if self._async_inflight >= bound:
-                err = AdmissionRejected(
-                    f"async submissions in flight at bound "
-                    f"({self._async_inflight}/{bound})",
-                    in_flight=self._async_inflight, bound=bound,
-                    query_id=record["id"])
-                record["status"] = "rejected"
-                record["error"] = err.to_dict()
-                record["finished_ts"] = time.time()
-                self.metrics.counter("service_rejected").inc()
-                self._post("rejected", record["id"],
-                           detail="asyncInFlight", session=session)
-                raise err
-            self._async_inflight += 1
+            in_flight = self._async_inflight
+            rejected = in_flight >= bound
+            if not rejected:
+                self._async_inflight += 1
+        if rejected:
+            err = AdmissionRejected(
+                f"async submissions in flight at bound "
+                f"({in_flight}/{bound})",
+                in_flight=in_flight, bound=bound,
+                query_id=record["id"])
+            record["status"] = "rejected"
+            record["error"] = err.to_dict()
+            record["finished_ts"] = time.time()
+            self.metrics.counter("service_rejected").inc()
+            self._post("rejected", record["id"],
+                       detail="asyncInFlight", session=session)
+            raise err
 
         def run():
             # re-drive through submit's machinery minus re-registration
@@ -518,9 +537,10 @@ class SqlService:
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=10)
             self._serve_thread = None
-        if self._installed_arbiter:
-            install_arbiter(None)
-            self._installed_arbiter = False
+        with self._install_lock:
+            if self._installed_arbiter:
+                install_arbiter(None)
+                self._installed_arbiter = False
 
 
 # ---------------------------------------------------------------------------

@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+from contextvars import ContextVar
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -93,7 +94,9 @@ KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "stream_sink_emit")
 
 #: test-registered extra seams (register_site): code under test may
-#: plant its own fire() points without editing the built-in tuple
+#: plant its own fire() points without editing the built-in tuple.
+#: Unguarded by design (guarded-by waiver): registration happens at
+#: test setup, before the seams it names run concurrently.
 _EXTRA_SITES: set = set()
 
 
@@ -232,8 +235,17 @@ class FaultPlan:
 
 #: the single armed plan, shared by every thread that reaches a seam
 #: (driver, prefetch workers, service pool threads); its hit counters
-#: are lock-guarded — see FaultPlan
+#: are lock-guarded — see FaultPlan. Rebinds (arm/reset) are atomic
+#: reference stores at execution entry — waived in the guarded-by
+#: registry; per-thread suppression is the ContextVar below, never a
+#: plan swap.
 _PLAN: Optional[FaultPlan] = None
+
+#: thread-confined suppression flag (see `suppressed`): ContextVars
+#: start fresh per thread, so a prefetch worker spawned during an
+#: analysis re-trace still fires its seams
+_SUPPRESS: ContextVar[bool] = ContextVar(
+    "spark_tpu_faults_suppress", default=False)
 
 
 def arm(conf) -> None:
@@ -260,24 +272,29 @@ def active() -> Optional[FaultPlan]:
 
 
 def fire(site: str) -> None:
-    """The injection point: no-op unless a plan is armed. Cheap enough
-    to sit on hot paths (one None check when disarmed)."""
-    if _PLAN is not None:
+    """The injection point: no-op unless a plan is armed and this
+    thread is not inside `suppressed()`. Cheap enough to sit on hot
+    paths (one None check when disarmed)."""
+    if _PLAN is not None and not _SUPPRESS.get():
         _PLAN.fire(site)
 
 
 @contextlib.contextmanager
 def suppressed():
-    """Temporarily disarm the plan WITHOUT losing its counters. The
-    observability layer's cost-analysis lowering re-traces a stage;
-    trace-time sites (shuffle, join_build, mesh) must count once per
-    REAL compile, so analysis-only traces run under this guard."""
-    global _PLAN
-    plan, _PLAN = _PLAN, None
+    """Temporarily disarm injection for THIS THREAD without losing the
+    plan's counters. The observability layer's cost-analysis lowering
+    re-traces a stage; trace-time sites (shuffle, join_build, mesh)
+    must count once per REAL compile, so analysis-only traces run
+    under this guard. Suppression is a ContextVar, not a plan swap:
+    the old `_PLAN = None` rebind disarmed the plan PROCESS-WIDE, so a
+    concurrent query's real compile on another service thread (or a
+    prefetch worker's decode) silently skipped its seams while any
+    thread was inside an analysis re-trace."""
+    token = _SUPPRESS.set(True)
     try:
         yield
     finally:
-        _PLAN = plan
+        _SUPPRESS.reset(token)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,852 @@
+"""Concurrency analyzer suite: guarded-by lint, lock-order graph,
+runtime lockwatch, and the multithreaded service stress test.
+
+Layout mirrors tests/test_analysis.py's lint sections: per-pass
+synthetic violations against injectable registries, a clean-tree
+zero-findings gate over the real repository, regression tests for the
+unguarded-write fixes this PR landed (listener-bus counters, faults
+suppression thread-confinement, arbiter install race, prefetch-worker
+join), and the stress test that proves the static lock-order claims
+against OBSERVED acquisition order under real concurrent load.
+"""
+
+import ast
+import json
+import os
+import threading
+import time
+import warnings
+
+import pandas as pd
+import pytest
+
+from spark_tpu.analysis.concurrency.guarded import (GuardedAnalysis,
+                                                    RegistryView)
+from spark_tpu.analysis.concurrency.lockorder import (LockOrderAnalysis,
+                                                      build_graph)
+from spark_tpu.analysis.concurrency.registry import (CONFINED, GUARDED_BY,
+                                                     LOCKS, WAIVERS,
+                                                     ConfinedDecl,
+                                                     GuardDecl, LockDecl,
+                                                     Waiver)
+from spark_tpu.testing.lockwatch import LockWatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# registry sanity
+# ---------------------------------------------------------------------------
+
+
+def test_registry_ids_and_ranks_unique():
+    ids = [d.lock_id for d in LOCKS]
+    assert len(ids) == len(set(ids)), "duplicate lock ids"
+    ranks = [d.rank for d in LOCKS]
+    assert len(ranks) == len(set(ranks)), \
+        "ranks must be distinct: they are the canonical total order"
+    sites = [(d.relpath, d.cls, d.attr) for d in LOCKS]
+    assert len(sites) == len(set(sites)), "duplicate lock sites"
+
+
+def test_registry_guards_reference_real_locks():
+    lock_attrs = {(d.relpath, d.cls): set() for d in LOCKS}
+    for d in LOCKS:
+        lock_attrs[(d.relpath, d.cls)].add(d.attr)
+    for g in GUARDED_BY:
+        assert g.lock in lock_attrs.get((g.relpath, g.cls), set()), \
+            f"GuardDecl {g} names a lock with no LockDecl"
+
+
+def test_registry_waivers_and_confined_carry_reasons():
+    for w in WAIVERS:
+        assert w.reason.strip(), f"empty waiver reason: {w}"
+    for c in CONFINED:
+        assert c.reason.strip(), f"empty confined reason: {c}"
+
+
+# ---------------------------------------------------------------------------
+# guarded-by pass: synthetic violations
+# ---------------------------------------------------------------------------
+
+_MOD = "spark_tpu/fake.py"
+
+
+def _view(locks=(), guards=(), waivers=(), confined=()):
+    return RegistryView(locks=locks, guards=guards, waivers=waivers,
+                        confined=confined, receiver_names={},
+                        receiver_attrs={}, factory_returns={},
+                        context_managers={}, extra_edges=(),
+                        held_callees={})
+
+
+def _run_guarded(src, view):
+    a = GuardedAnalysis(view)
+    a.add_file(_MOD, ast.parse(src))
+    return a.finish()
+
+
+_BOX_LOCK = LockDecl("t.box", _MOD, "Box", "_lock", "lock", 10)
+_BOX_GUARD = GuardDecl(_MOD, "Box", "items", "_lock")
+
+
+def test_guarded_by_clean_class():
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self.items.append(x)\n")
+    out = _run_guarded(src, _view((_BOX_LOCK,), (_BOX_GUARD,)))
+    assert out == [], out
+
+
+def test_guarded_by_flags_unguarded_write():
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "    def add(self, x):\n"
+        "        self.items.append(x)\n"          # no lock held
+        "    def reset(self):\n"
+        "        self.items = []\n")              # rebind, no lock
+    out = _run_guarded(src, _view((_BOX_LOCK,), (_BOX_GUARD,)))
+    codes = [(code, line) for _, line, code, _ in out]
+    assert ("GB101", 7) in codes and ("GB101", 9) in codes, out
+
+
+def test_guarded_by_flags_undeclared_shared_state():
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "        self.extra = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.extra += 1\n")  # guarded, but NOT declared
+    out = _run_guarded(src, _view((_BOX_LOCK,), (_BOX_GUARD,)))
+    assert [code for _, _, code, _ in out] == ["GB102"], out
+    # a waiver (with its reason) silences it
+    out2 = _run_guarded(src, _view(
+        (_BOX_LOCK,), (_BOX_GUARD,),
+        waivers=(Waiver(_MOD, "Box", "extra", "benign test race"),)))
+    assert out2 == [], out2
+
+
+def test_guarded_by_flags_unregistered_and_stale_locks():
+    src = (
+        "import threading\n"
+        "class Rogue:\n"
+        "    def __init__(self):\n"
+        "        self._mystery = threading.Lock()\n")
+    out = _run_guarded(src, _view((_BOX_LOCK,)))
+    codes = {code for _, _, code, _ in out}
+    # Rogue._mystery exists but is unregistered; t.box is declared but
+    # has no creation site in this synthetic tree
+    assert codes == {"GB104", "GB105"}, out
+
+
+def test_guarded_by_confined_class_skips_checks():
+    src = (
+        "class Driver:\n"
+        "    def step(self):\n"
+        "        self.cursor = 1\n")
+    view = _view(confined=(ConfinedDecl(_MOD, "Driver", "ctxvar"),))
+    assert _run_guarded(src, view) == []
+
+
+def test_guarded_by_module_globals_and_contextvar():
+    src = (
+        "from contextvars import ContextVar\n"
+        "V = ContextVar('v', default=None)\n"
+        "STATE = {}\n"
+        "def set_v(x):\n"
+        "    global V\n"
+        "    V = x\n"                 # ContextVar-backed: confined
+        "def poke(k):\n"
+        "    STATE[k] = 1\n")         # module dict, no guard: flagged
+    # bring the module into write-check scope via a module-level guard
+    # (OTHER/_L are stale and separately reported as GB103; only the
+    # global-write verdicts matter here)
+    view = _view(guards=(GuardDecl(_MOD, "", "OTHER", "_L"),))
+    out = _run_guarded(src, view)
+    gb102 = [msg for _, _, code, msg in out if code == "GB102"]
+    assert any("STATE" in m for m in gb102), out
+    assert not any("module global V " in m for m in gb102), \
+        "ContextVar-backed global must be recognized as confined"
+
+
+# ---------------------------------------------------------------------------
+# lock-order pass: synthetic graphs
+# ---------------------------------------------------------------------------
+
+
+def _run_lockorder(src, view):
+    a = LockOrderAnalysis(view)
+    a.add_file(_MOD, ast.parse(src))
+    return a.finish()
+
+
+def test_lock_order_nested_with_edge_and_inversion():
+    locks = (LockDecl("t.a", _MOD, "Two", "_a", "lock", 10),
+             LockDecl("t.b", _MOD, "Two", "_b", "lock", 20))
+    good = (
+        "class Two:\n"
+        "    def fwd(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n")
+    edges, out = _run_lockorder(good, _view(locks))
+    assert ("t.a", "t.b") in edges and out == [], (edges, out)
+    bad = good.replace("self._a", "X").replace("self._b", "self._a") \
+        .replace("X", "self._b")
+    edges, out = _run_lockorder(bad, _view(locks))
+    assert ("t.b", "t.a") in edges
+    assert [code for _, _, code, _ in out] == ["LO202"], out
+
+
+def test_lock_order_cycle_detected_via_call_graph():
+    # equal ranks on purpose: the rank check alone cannot carry the
+    # verdict, so the cycle detector must fire on a -> b -> a — one
+    # direction extracted through a method CALL made under a held
+    # lock, the other declared via EXTRA_EDGES (the escape hatch for
+    # holds the lexical extractor cannot see)
+    locks = (LockDecl("t.a", _MOD, "P", "_a", "lock", 10),
+             LockDecl("t.b", _MOD, "P", "_b", "lock", 10))
+    src = (
+        "class P:\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            self.two()\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            pass\n")
+    view = _view(locks)
+    view.extra_edges = (("t.b", "t.a", "synthetic reverse edge"),)
+    a = LockOrderAnalysis(view)
+    a.add_file(_MOD, ast.parse(src))
+    edges, out = a.finish()
+    assert ("t.a", "t.b") in edges and ("t.b", "t.a") in edges, edges
+    assert any(code == "LO201" and "cycle" in msg
+               for _, _, code, msg in out), out
+
+
+def test_lock_order_multi_item_with_records_inter_item_edge():
+    """`with self._a, self._b:` — item a is held when item b acquires,
+    so the a->b edge (and an inversion written that way) must not slip
+    past the static pass."""
+    locks = (LockDecl("t.a", _MOD, "M", "_a", "lock", 10),
+             LockDecl("t.b", _MOD, "M", "_b", "lock", 20))
+    src = (
+        "class M:\n"
+        "    def both(self):\n"
+        "        with self._a, self._b:\n"
+        "            pass\n")
+    edges, out = _run_lockorder(src, _view(locks))
+    assert ("t.a", "t.b") in edges and out == [], (edges, out)
+    inverted = src.replace("self._a, self._b", "self._b, self._a")
+    edges, out = _run_lockorder(inverted, _view(locks))
+    assert ("t.b", "t.a") in edges
+    assert [code for _, _, code, _ in out] == ["LO202"], out
+
+
+def test_guarded_by_multi_item_with_counts_earlier_items_held():
+    src = (
+        "import threading\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "    def add(self, x):\n"
+        "        with self._lock, open('f'):\n"
+        "            self.items.append(x)\n")
+    out = _run_guarded(src, _view((_BOX_LOCK,), (_BOX_GUARD,)))
+    assert out == [], out
+
+
+def test_lock_order_self_deadlock_on_non_reentrant_lock():
+    locks = (LockDecl("t.a", _MOD, "R", "_a", "lock", 10),)
+    src = (
+        "class R:\n"
+        "    def outer(self):\n"
+        "        with self._a:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._a:\n"
+        "            pass\n")
+    _, out = _run_lockorder(src, _view(locks))
+    assert any(code == "LO201" and "self-deadlock" in msg
+               for _, _, code, msg in out), out
+    # the same shape on an rlock is legal
+    rlocks = (LockDecl("t.a", _MOD, "R", "_a", "rlock", 10),)
+    _, out2 = _run_lockorder(src, _view(rlocks))
+    assert out2 == [], out2
+
+
+# ---------------------------------------------------------------------------
+# real tree: clean gate + graph shape
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_passes_clean_on_real_tree():
+    from spark_tpu.analysis.lints import run_passes
+    notes = []
+    out = run_passes(["guarded-by", "lock-order"], repo=REPO,
+                     collect_notes=notes)
+    assert [v.render() for v in out] == []
+    # the waiver list is reviewer-visible in the lint output
+    assert sum(n.startswith("waiver:") for n in notes) == len(WAIVERS)
+    assert any(n.startswith("lock-order:") for n in notes)
+
+
+def test_static_graph_has_known_edges_and_ascends():
+    edges, violations = build_graph(REPO)
+    assert violations == [], violations
+    # the load-bearing nestings extracted from code, not declared:
+    # arbiter holds its cv while evicting storage, and while counting
+    assert ("service.arbiter", "io.device_cache") in edges
+    assert ("service.arbiter", "metrics.counter") in edges
+    # factory-return chains resolve (registry.counter(x).inc())
+    assert ("service.admission", "metrics.registry") in edges
+    from spark_tpu.analysis.concurrency.registry import rank_of
+    for a, b in edges:
+        if a != b:
+            assert rank_of(a) < rank_of(b), (a, b)
+
+
+def test_tracer_leak_scope_covers_service_and_observability(tmp_path):
+    from spark_tpu.analysis.lints import run_passes
+    files = {
+        "spark_tpu/service/bad.py": "k = hash(col.data)\n",
+        "spark_tpu/observability/bad.py": "b = bool(jnp.any(x))\n",
+        "spark_tpu/streaming.py": "h = hash(batch.validity)\n",
+        "spark_tpu/ml/fine.py": "h = hash(x)\n",  # out of scope
+    }
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    out = run_passes(["tracer-leak"], repo=str(tmp_path))
+    flagged = {v.path for v in out}
+    assert flagged == {"spark_tpu/service/bad.py",
+                       "spark_tpu/observability/bad.py",
+                       "spark_tpu/streaming.py"}, out
+
+
+def test_lint_json_output_shape(capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "lint_cli_json", os.path.join(REPO, "scripts", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--json", "guarded-by", "lock-order"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] is True
+    assert payload["passes"] == ["guarded-by", "lock-order"]
+    assert payload["violations"] == []
+    assert any(n.startswith("waiver:") for n in payload["notes"])
+
+
+def test_lint_severity_flows_and_warn_does_not_fail(capsys):
+    """The (line, msg, code, severity) tuple protocol is live end to
+    end: a warn-severity violation surfaces in text and --json output
+    but exits 0 (only error severity fails the lint)."""
+    import importlib.util
+
+    from spark_tpu.analysis.lints import (LINT_PASSES, LintPass,
+                                          register_lint, run_passes)
+
+    @register_lint
+    class _WarnOnly(LintPass):
+        name = "test-warn-only"
+        code = "TW100"
+        doc = "synthetic warn emitter"
+
+        def scope(self, relpath):
+            return False
+
+        def check(self, tree, relpath, ctx):
+            return []
+
+        def finish(self, ctx):
+            return [("somewhere.py", 1, "advisory only", "TW100",
+                     "warn")]
+
+    try:
+        out = run_passes(["test-warn-only"], repo=REPO)
+        assert [(v.code, v.severity) for v in out] == \
+            [("TW100", "warn")]
+        spec = importlib.util.spec_from_file_location(
+            "lint_cli_warn", os.path.join(REPO, "scripts", "lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(["test-warn-only"])
+        text = capsys.readouterr().out
+        assert rc == 0 and "ok with 1 warning(s)" in text, text
+        rc = mod.main(["--json", "test-warn-only"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0 and payload["ok"] is True
+        assert payload["violations"][0]["severity"] == "warn"
+    finally:
+        del LINT_PASSES["test-warn-only"]
+
+
+# ---------------------------------------------------------------------------
+# lockwatch units
+# ---------------------------------------------------------------------------
+
+
+class _Holder:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+
+
+def test_lockwatch_records_edges_and_asserts_order():
+    h = _Holder()
+    watch = LockWatch()
+    # real registry ids so rank lookups work: pool (14) < registry (60)
+    watch.watch_attr(h, "a", "service.pool")
+    watch.watch_attr(h, "b", "metrics.registry")
+    with h.a:
+        with h.b:
+            pass
+    assert watch.edges() == {("service.pool", "metrics.registry"): 1}
+    watch.assert_order_consistent()
+    stats = watch.report()["locks"]
+    assert stats["service.pool"]["acquires"] == 1
+    assert stats["service.pool"]["hold_s"] > 0
+    watch.uninstall()
+    assert h.a.__class__ is threading.Lock().__class__
+
+
+def test_lockwatch_detects_inverted_order():
+    h = _Holder()
+    watch = LockWatch()
+    watch.watch_attr(h, "a", "metrics.registry")   # rank 60
+    watch.watch_attr(h, "b", "service.pool")       # rank 14
+    with h.a:
+        with h.b:  # 60 held while acquiring 14: inversion
+            pass
+    with pytest.raises(AssertionError, match="inverts the registry"):
+        watch.assert_order_consistent()
+    watch.uninstall()
+
+
+def test_lockwatch_condition_wait_releases_hold():
+    class _CvBox:
+        def __init__(self):
+            self.cv = threading.Condition()
+
+    box = _CvBox()
+    watch = LockWatch()
+    watch.watch_attr(box, "cv", "service.admission")
+    state = {"ready": False}
+
+    def producer():
+        with box.cv:
+            state["ready"] = True
+            box.cv.notify_all()
+
+    with box.cv:
+        t = threading.Thread(target=producer)
+        t.start()
+        # wait() releases the cv (the producer can take it) and the
+        # watch pops/re-pushes the held entry around the inner wait
+        assert box.cv.wait_for(lambda: state["ready"], timeout=5)
+    t.join(5)
+    watch.assert_order_consistent()
+    assert watch.report()["locks"]["service.admission"]["acquires"] >= 2
+    watch.uninstall()
+
+
+def test_lockwatch_counts_contention():
+    h = _Holder()
+    watch = LockWatch()
+    watch.watch_attr(h, "a", "service.pool")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with h.a:
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    entered.wait(5)
+    got = h.a.acquire(blocking=False)
+    assert got is False
+    release.set()
+    t.join(5)
+    assert watch.report()["locks"]["service.pool"]["contended"] >= 1
+    watch.uninstall()
+
+
+def test_lockwatch_distinct_same_id_locks_flag_abba_shape():
+    """Two DIFFERENT lock objects sharing one lock id (two sessions'
+    leases) nested on one thread is an ABBA deadlock shape no rank
+    ordering can catch — it must record and fail the consistency
+    assert (same-OBJECT reentrancy must not)."""
+    h = _Holder()
+    watch = LockWatch()
+    watch.watch_attr(h, "a", "service.session")
+    watch.watch_attr(h, "b", "service.session")  # distinct lock, same id
+    with h.a:
+        with h.b:
+            pass
+    assert ("service.session", "service.session") in watch.edges()
+    with pytest.raises(AssertionError, match="ABBA"):
+        watch.assert_order_consistent()
+    watch.uninstall()
+
+
+def test_lockwatch_reentrant_same_object_not_flagged():
+    class _R:
+        def __init__(self):
+            self.lk = threading.RLock()
+
+    h = _R()
+    watch = LockWatch()
+    watch.watch_attr(h, "lk", "io.device_cache")
+    with h.lk:
+        with h.lk:  # same object: genuine reentrancy, no edge
+            pass
+    assert watch.edges() == {}
+    watch.assert_order_consistent()
+    watch.uninstall()
+
+
+def test_guarded_by_nested_function_global_reported_once():
+    """A violation inside a nested def must be reported exactly once
+    (the module scan walks top-level functions only; _walk recursion
+    covers nesting)."""
+    src = (
+        "STATE = {}\n"
+        "def outer():\n"
+        "    def inner():\n"
+        "        STATE['k'] = 1\n"
+        "    inner()\n")
+    view = _view(guards=(GuardDecl(_MOD, "", "STATE", "_L"),))
+    out = [v for v in _run_guarded(src, view) if v[2] == "GB101"]
+    assert len(out) == 1, out
+
+
+def test_lockwatch_thread_leak_assertion():
+    watch = LockWatch()
+    ok = threading.Thread(target=lambda: time.sleep(0.2), daemon=True,
+                          name="spark-tpu-leaktest-short")
+    ok.start()
+    watch.assert_no_thread_leak(prefix="spark-tpu-leaktest-short",
+                                timeout_s=5)
+    bad = threading.Thread(target=lambda: time.sleep(10), daemon=True,
+                           name="spark-tpu-leaktest-long")
+    bad.start()
+    with pytest.raises(AssertionError, match="still alive"):
+        watch.assert_no_thread_leak(prefix="spark-tpu-leaktest-long",
+                                    timeout_s=0.3)
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the fixes the guarded-by pass demanded
+# ---------------------------------------------------------------------------
+
+
+def test_listener_bus_drop_counter_is_lossless_under_threads():
+    """`dropped += 1` was an unlocked read-modify-write: concurrent
+    service threads posting through a raising listener lost counts."""
+    from spark_tpu.observability.listener import (ListenerBus,
+                                                  QueryListener,
+                                                  QueryStartEvent)
+
+    class Raising(QueryListener):
+        def on_query_start(self, event):
+            raise RuntimeError("boom")
+
+    bus = ListenerBus()
+    bus.register(Raising())
+    threads, posts = 8, 25
+    barrier = threading.Barrier(threads)
+
+    def worker():
+        barrier.wait()
+        for i in range(posts):
+            bus.post("on_query_start",
+                     QueryStartEvent(query_id=i, ts=0.0, plan=""))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+    assert bus.dropped == threads * posts
+
+
+def test_listener_bus_concurrent_register_during_post():
+    from spark_tpu.observability.listener import (ListenerBus,
+                                                  QueryListener,
+                                                  QueryStartEvent)
+
+    class Quiet(QueryListener):
+        pass
+
+    bus = ListenerBus()
+    stop = threading.Event()
+
+    def churn():
+        li = Quiet()
+        while not stop.is_set():
+            bus.register(li)
+            bus.unregister(li)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for i in range(500):
+            bus.post("on_query_start",
+                     QueryStartEvent(query_id=i, ts=0.0, plan=""))
+    finally:
+        stop.set()
+        t.join(10)
+    assert bus.dropped == 0
+
+
+def test_faults_suppression_is_thread_confined(session):
+    """`suppressed()` used to swap the GLOBAL plan to None: any thread
+    inside an analysis re-trace disarmed chaos sites for EVERY
+    concurrent query. Suppression is now a ContextVar: another
+    thread's fire() still counts (and raises) while this thread is
+    suppressed."""
+    from spark_tpu.testing import faults
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold_suppressed():
+        with faults.suppressed():
+            entered.set()
+            release.wait(10)
+
+    with faults.inject(session.conf, "scan_load:fatal:1") as plan:
+        t = threading.Thread(target=hold_suppressed)
+        t.start()
+        try:
+            assert entered.wait(10)
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("scan_load")
+            assert plan.fired_log, "fire was suppressed cross-thread"
+        finally:
+            release.set()
+            t.join(10)
+
+
+def test_faults_suppression_still_masks_same_thread(session):
+    from spark_tpu.testing import faults
+    with faults.inject(session.conf, "scan_load:fatal:1") as plan:
+        with faults.suppressed():
+            faults.fire("scan_load")  # must NOT raise or count
+        assert plan.fired_log == []
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("scan_load")
+
+
+def test_service_arbiter_install_race_installs_exactly_once():
+    from spark_tpu import Conf
+    from spark_tpu.service.arbiter import get_arbiter, install_arbiter
+    from spark_tpu.service.server import SqlService
+    conf = Conf()
+    conf.set("spark_tpu.service.hbmBudget", 1 << 30)
+    svc = SqlService(conf)
+    try:
+        barrier = threading.Barrier(8)
+
+        def racer():
+            barrier.wait()
+            svc._ensure_arbiter()
+
+        ts = [threading.Thread(target=racer) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert get_arbiter() is svc.arbiter
+        assert svc._installed_arbiter
+    finally:
+        svc.stop()
+    assert get_arbiter() is None  # stop() uninstalled what it owned
+
+
+class _FakeChunkSource:
+    """Minimal ChunkIterator stand-in for prefetch-worker tests: slow
+    host decodes so close() interrupts a mid-stream pipeline."""
+
+    def __init__(self, chunks=50, delay_s=0.01):
+        self.dictionaries = {}
+        self._i = 0
+        self._n = chunks
+        self._delay = delay_s
+
+    def _host_next(self):
+        time.sleep(self._delay)
+        if self._i >= self._n:
+            return None
+        self._i += 1
+        return ("chunk", self._i)
+
+    def _to_device(self, payload):
+        return payload
+
+    def skip_chunks(self, n):
+        return 0
+
+
+def test_prefetch_close_joins_worker(session):
+    from spark_tpu.io.sources import PrefetchChunkIterator
+    it = PrefetchChunkIterator(_FakeChunkSource(), session.conf)
+    assert next(it) == ("chunk", 1)
+    assert next(it) == ("chunk", 2)
+    t = it._thread
+    assert t is not None and t.is_alive()
+    it.close()
+    assert not t.is_alive(), "close() must JOIN the worker"
+    assert it._thread is None
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_close_before_start_and_exhaustion(session):
+    from spark_tpu.io.sources import PrefetchChunkIterator
+    it = PrefetchChunkIterator(_FakeChunkSource(chunks=2), session.conf)
+    it.close()  # never started: no thread, no error
+    it2 = PrefetchChunkIterator(_FakeChunkSource(chunks=2, delay_s=0.0),
+                                session.conf)
+    assert [x for x in it2] == [("chunk", 1), ("chunk", 2)]
+    LockWatch().assert_no_thread_leak(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# the multithreaded stress test: static claims, dynamically proven
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stress_path(tmp_path_factory):
+    from spark_tpu.tpch.datagen import write_parquet
+    path = str(tmp_path_factory.mktemp("tpch_stress") / "sf")
+    write_parquet(path, 0.002)
+    return path
+
+
+def test_service_stress_under_lockwatch(stress_path, tmp_path):
+    """N sessions x M queries on the live service — chunked scans with
+    prefetch workers, arbiter leasing, admission queueing, event-log
+    writes, live /metrics scraping — under lockwatch: every query at
+    golden parity, the OBSERVED lock acquisition order consistent with
+    the static registry ranking, and no prefetch daemon outliving its
+    query."""
+    import urllib.request
+
+    from spark_tpu import Conf
+    from spark_tpu.observability.metrics import parse_prometheus_text
+    from spark_tpu.service.arbiter import install_arbiter
+    from spark_tpu.service.server import SqlService
+    from spark_tpu.tpch import golden as G
+    from spark_tpu.tpch import queries as Q
+    from spark_tpu.tpch import sql_queries as SQLQ
+
+    sessions = ["s1", "s2", "s3"]
+    conf = Conf()
+    conf.set("spark_tpu.service.port", 0)
+    conf.set("spark_tpu.service.maxConcurrent", 2)
+    conf.set("spark_tpu.service.queueDepth", 8)
+    conf.set("spark_tpu.service.queueTimeoutMs", 120000)
+    conf.set("spark_tpu.service.hbmBudget", 1 << 30)  # arbiter live
+    conf.set("spark_tpu.sql.execution.streamingChunkRows", 4096)
+    conf.set("spark_tpu.sql.io.deviceCacheBytes", 0)  # re-stream scans
+    conf.set("spark_tpu.sql.ingest.prefetch", True)
+    conf.set("spark_tpu.sql.eventLog.dir", str(tmp_path / "events"))
+    svc = SqlService(
+        conf,
+        init_session=lambda s: Q.register_tables(s, stress_path)).start()
+    watch = LockWatch()
+    try:
+        # warm every session first (pool entries + compiled stages
+        # exist), then install the watch over the warm topology
+        for name in sessions:
+            svc.submit(SQLQ.Q1, session=name)
+        watch.install_service(svc)
+
+        results, errors = [], []
+        stop_scrape = threading.Event()
+
+        def run_queries(name):
+            try:
+                for _ in range(2):
+                    record, table = svc.submit(SQLQ.Q1, session=name)
+                    results.append((record["id"], table))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((name, repr(e)))
+
+        def scrape():
+            while not stop_scrape.is_set():
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{svc.port}/metrics",
+                    timeout=30).read().decode()
+                parse_prometheus_text(text)
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{svc.port}/queries",
+                    timeout=30).read()
+                time.sleep(0.02)
+
+        scraper = threading.Thread(target=scrape, daemon=True)
+        scraper.start()
+        threads = [threading.Thread(target=run_queries, args=(n,))
+                   for n in sessions]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        stop_scrape.set()
+        scraper.join(30)
+        # a wedged worker must fail loudly, not pass vacuously
+        assert not any(t.is_alive() for t in threads), "query wedged"
+        assert errors == [], errors
+        assert len(results) == 6
+
+        # golden parity for every concurrent result
+        want = G.GOLDEN["q1"](stress_path).reset_index(drop=True)
+        for _, table in results:
+            got = G.normalize_decimals(
+                table.to_pandas())[list(want.columns)]
+            G.compare(got.reset_index(drop=True), want)
+
+        # the dynamic half of the tentpole: observed acquisition order
+        # is consistent with the registry the static pass proved
+        edges = watch.edges()
+        assert edges, "no lock nesting observed — stress is vacuous"
+        assert any(a == "service.session" for a, _ in edges), edges
+        watch.assert_order_consistent()
+        # prefetch must actually have run (chunked scans with the
+        # double-buffered ingest on): otherwise the thread-leak claim
+        # below is vacuous
+        snap = svc.metrics.snapshot()["counters"]
+        assert any(k.startswith("ingest_") for k in snap), snap
+        # PrefetchChunkIterator.close()/exhaustion audit: no ingest
+        # daemon outlives the queries that spawned it
+        watch.assert_no_thread_leak()
+        # contention actually happened (shared registry under 3
+        # sessions + scraper) — the stats are live, not decorative
+        report = watch.report()
+        assert report["locks"], report
+    finally:
+        watch.uninstall()
+        svc.stop()
+        install_arbiter(None)
