@@ -21,7 +21,9 @@ Usage:
     scripts/perf_gate.py [--update]        # --update re-calibrates
 Env:
     PERF_GATE_SF (default 0.01), PERF_GATE_THRESHOLD_PCT (default 25),
-    PERF_GATE_FLOOR_MS (default 200), PERF_GATE_QUERIES (q1,q3)
+    PERF_GATE_FLOOR_MS (default 200), PERF_GATE_QUERIES (q1,q3),
+    PERF_GATE_COMPILE=1 (opt-in: also measure + gate the
+    compile-cache cold/warm-process rows, tpch_q*_compile_*_ms)
 """
 
 from __future__ import annotations
@@ -37,13 +39,25 @@ BASELINE_PATH = os.path.join(REPO, "PERF_BASELINE.json")
 sys.path.insert(0, REPO)
 
 
+#: baseline metric families merged independently from BENCH rounds:
+#: name -> key regex. `compile` carries the compile-cache section's
+#: cold/warm-process compile rows (bench_compile_cache), so warm
+#: compile-time regressions enter the gated baseline like wall-clock.
+FAMILIES = (
+    ("tpch", r"tpch_q\d+_sf[\d.]+_ms$"),
+    ("tpcds", r"tpcds_q\d+_sf[\d.]+_ms$"),
+    ("compile", r"tpch_q\d+_compile_(?:cold|warm)_ms$"),
+)
+
+
 def last_good_bench() -> tuple:
     """(name, {metric: ms}) merged PER FAMILY from the newest
     BENCH_*.json rounds: tpch_*_ms from the newest round that carries
-    any, tpcds_*_ms likewise — a round whose tpch section timed out
-    but whose tpcds section parsed must not shadow an older round's
-    good tpch numbers (and vice versa). `name` is the newest
-    contributing round; (None, {}) when the trajectory is dark."""
+    any, tpcds_*_ms likewise, tpch_*_compile_*_ms likewise — a round
+    whose tpch section timed out but whose tpcds section parsed must
+    not shadow an older round's good tpch numbers (and vice versa).
+    `name` is the newest contributing round; (None, {}) when the
+    trajectory is dark."""
     rounds = []
     for name in os.listdir(REPO):
         m = re.match(r"BENCH_r(\d+)\.json$", name)
@@ -58,17 +72,17 @@ def last_good_bench() -> tuple:
         except (OSError, ValueError):
             continue
         extra = ((doc.get("parsed") or {}).get("extra")) or {}
-        for fam in ("tpch", "tpcds"):
+        for fam, rx in FAMILIES:
             if fam in seen_families:
                 continue
             ms = {k: float(v) for k, v in extra.items()
-                  if re.match(fam + r"_q\d+_sf[\d.]+_ms$", k)}
+                  if re.match(rx, k)}
             if ms:
                 seen_families.add(fam)
                 merged.update(ms)
                 if newest is None:
                     newest = name
-        if len(seen_families) == 2:
+        if len(seen_families) == len(FAMILIES):
             break
     return newest, merged
 
@@ -170,6 +184,15 @@ def main(argv) -> int:
     sf_env = os.environ.get("PERF_GATE_SF")
     sf = float(sf_env) if sf_env else _default_sf(bench_ms)
     current = measure(sf, queries, tpcds_queries)
+    if os.environ.get("PERF_GATE_COMPILE"):
+        # opt-in (two fresh subprocesses, ~1min): the compile-cache
+        # cold/warm-process rows join the gated set — a warm-compile
+        # regression (deserialization suddenly recompiling) fails
+        # preflight like a wall-clock regression would
+        import bench
+        cc = bench.bench_compile_cache(None)
+        current.update({k: float(v) for k, v in cc.items()
+                        if re.match(FAMILIES[2][1], k)})
     key = platform_key(sf)
 
     baselines = {}
@@ -192,6 +215,11 @@ def main(argv) -> int:
                     bkey = f"{fam}_{name}_sf{sf:g}_ms"
                     if bkey in bench_ms:
                         seeded[f"{fam}_{name}_ms"] = bench_ms[bkey]
+            # compile-cache rows are sf-less (bench emits them from a
+            # fixed-size subprocess pair): seed the ones we measure
+            for k, v in bench_ms.items():
+                if re.match(FAMILIES[2][1], k) and k in current:
+                    seeded[k] = v
         source = bench_name if seeded else "self"
         # per-family merge: bench-seeded keys win, the current
         # measurement fills every family the bench round didn't carry

@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Twelve stages, all mandatory:
+# Thirteen stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -68,9 +68,15 @@
 #      acquisition order consistent with the static registry ranking,
 #      golden parity per query, and no prefetch daemon outliving its
 #      query
+#  13. compile-cache smoke: cold TPC-H Q1 in-process with the
+#      persistent AOT compile cache on, then Q1 in a FRESH subprocess
+#      over the same cache dir asserting compile_cache_disk_hits >= 1
+#      with ZERO disk misses (no backend recompiles of cached shapes)
+#      and byte-identical results, plus a corrupted-entry run proving
+#      the compile_cache_corrupt fallback never fails the query
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-12 still run) for
+#   --fast skips the full pytest suite (stages 2-13 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -83,7 +89,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/12: tier-1 test suite --"
+    echo "-- stage 1/13: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -97,16 +103,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/12: SKIPPED (--fast) --"
+    echo "-- stage 1/13: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/12: dryrun_multichip(8) --"
+echo "-- stage 2/13: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/12: bench smoke --"
+echo "-- stage 3/13: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -138,7 +144,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/12: chaos smoke --"
+echo "-- stage 4/13: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -192,7 +198,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/12: observability + analysis smoke --"
+echo "-- stage 5/13: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -285,10 +291,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/12: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/13: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/12: SQL service smoke --"
+echo "-- stage 7/13: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -362,7 +368,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/12: join-kernel + ingest parity smoke --"
+echo "-- stage 8/13: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -420,7 +426,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/12: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/13: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -464,7 +470,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/12: elastic mesh smoke --"
+echo "-- stage 10/13: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -514,7 +520,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/12: streaming durability smoke --"
+echo "-- stage 11/13: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -607,7 +613,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/12: concurrency smoke --"
+echo "-- stage 12/13: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -689,5 +695,102 @@ finally:
 print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
+
+echo "-- stage 13/13: compile-cache smoke --"
+# Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
+# subprocess over the same dir must open warm (disk_hits >= 1, ZERO
+# disk misses = no backend recompiles of cached shapes) with
+# byte-identical results; a corrupted entry must fall back to a fresh
+# compile (compile_cache_corrupt) and still hit parity.
+env JAX_PLATFORMS=cpu python - <<'EOF11'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import warnings
+
+from spark_tpu import SparkTpuSession
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+base = tempfile.mkdtemp(prefix="preflight_cc_")
+path = base + "/sf"
+cc_dir = base + "/cache"
+write_parquet(path, 0.001)
+
+spark = SparkTpuSession.builder().get_or_create()
+spark.conf.set("spark_tpu.sql.compileCache.enabled", True)
+spark.conf.set("spark_tpu.sql.compileCache.dir", cc_dir)
+Q.register_tables(spark, path)
+
+# (a) cold in-process run: entries + manifest land on disk
+qe = Q.QUERIES["q1"](spark)._qe()
+cold = G.normalize_decimals(qe.collect().to_pandas())
+G.compare(cold.reset_index(drop=True), G.GOLDEN["q1"](path))
+entries = [f for f in os.listdir(cc_dir) if f.startswith("cc-")]
+assert entries, "cold run stored no compile-cache entries"
+cold_csv = cold.to_csv(index=False)
+
+# (b) warm FRESH-PROCESS run: deserialization only, byte parity
+CHILD = r'''
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from spark_tpu import SparkTpuSession
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+path, cc_dir = sys.argv[1], sys.argv[2]
+spark = SparkTpuSession.builder().get_or_create()
+spark.conf.set("spark_tpu.sql.compileCache.enabled", True)
+spark.conf.set("spark_tpu.sql.compileCache.dir", cc_dir)
+Q.register_tables(spark, path)
+got = G.normalize_decimals(
+    Q.QUERIES["q1"](spark)._qe().collect().to_pandas())
+m = spark.metrics
+print("CCSMOKE " + json.dumps({
+    "csv": got.to_csv(index=False),
+    "disk_hits": int(m.counter("compile_cache_disk_hits").value),
+    "disk_misses": int(m.counter("compile_cache_disk_misses").value),
+    "corrupt": int(m.counter("compile_cache_corrupt").value),
+}), flush=True)
+'''
+
+
+def run_child():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", CHILD, path, cc_dir],
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    for line in proc.stdout.splitlines():
+        if line.startswith("CCSMOKE "):
+            return json.loads(line[len("CCSMOKE "):])
+    raise AssertionError(
+        f"child rc={proc.returncode}: {proc.stderr[-800:]}")
+
+
+warm = run_child()
+assert warm["disk_hits"] >= 1, warm
+assert warm["disk_misses"] == 0, \
+    f"warm process recompiled a cached shape: {warm}"
+assert warm["csv"] == cold_csv, "warm-process result diverged"
+
+# (c) corrupted entry: fresh subprocess must log+count+recompile,
+# never fail, and still hit byte parity
+victim = os.path.join(cc_dir, sorted(
+    f for f in os.listdir(cc_dir) if f.startswith("cc-"))[0])
+with open(victim, "wb") as f:
+    f.write(b"torn")
+fixed = run_child()
+assert fixed["corrupt"] >= 1, fixed
+assert fixed["csv"] == cold_csv, "corrupt-fallback result diverged"
+assert os.path.getsize(victim) > 4, "bad entry was not overwritten"
+
+print(json.dumps({"preflight_compile_cache_smoke": "ok",
+                  "entries": len(entries),
+                  "warm_disk_hits": warm["disk_hits"],
+                  "corrupt_recovered": fixed["corrupt"]}))
+EOF11
 
 echo "== preflight PASSED =="

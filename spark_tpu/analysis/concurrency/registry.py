@@ -125,6 +125,14 @@ LOCKS: Tuple[LockDecl, ...] = (
     LockDecl("faults.plan", "spark_tpu/testing/faults.py", "FaultPlan",
              "_lock", "lock", 56,
              "hit counters (fault effects run OUTSIDE it)"),
+    LockDecl("execution.compile_cache",
+             "spark_tpu/execution/compile_cache.py", "CompileCache",
+             "_lock", "lock", 58,
+             "persistent compile cache: serializes entry publish, "
+             "LRU eviction and manifest maintenance within a process "
+             "(cross-process safety is atomic renames); pure file "
+             "I/O inside — counters inc and fault seams fire OUTSIDE "
+             "it, so nothing nests under it"),
     LockDecl("metrics.registry", _OBS + "metrics.py", "MetricsRegistry",
              "_lock", "lock", 60, "metric instrument map"),
     LockDecl("metrics.flush", _OBS + "metrics.py", "MetricsRegistry",
@@ -232,6 +240,22 @@ WAIVERS: Tuple[Waiver, ...] = (
            "plain dict with GIL-atomic get/set; worst case is a "
            "duplicate stage compile whose last write wins (keys are "
            "deterministic content hashes, both values equivalent)"),
+    Waiver("spark_tpu/execution/compile_cache.py", "CachedStageFn",
+           "_jit",
+           "GIL-atomic store of a lazily-built jit fallback; a race "
+           "builds a duplicate equivalent jit whose last write wins "
+           "(the arbiter.stage_cache precedent, one level down)"),
+    Waiver("spark_tpu/execution/compile_cache.py", "CachedStageFn",
+           "_compiled",
+           "GIL-atomic list append of a (signature, Compiled) pair; "
+           "racing adds of the same signature at worst duplicate an "
+           "equivalent executable — compiled_for returns the first "
+           "match, and entries are never removed"),
+    Waiver("spark_tpu/execution/compile_cache.py", "CachedStageFn",
+           "_make_jit",
+           "bind_builder only fills a None slot with an equivalent "
+           "thunk (every binder closes over the same plan for this "
+           "stage key); GIL-atomic store, last write wins"),
     Waiver(_SVC + "pool.py", "_Entry", "current_record",
            "written by the server only while holding this entry's "
            "session lease (service.session): single writer per leased "
@@ -245,6 +269,10 @@ WAIVERS: Tuple[Waiver, ...] = (
     Waiver(_SVC + "server.py", "SqlService", "_serve_thread",
            "lifecycle attr written by the owning control thread in "
            "start()/stop(), not on the request path"),
+    Waiver(_SVC + "server.py", "SqlService", "_warm_thread",
+           "lifecycle attr written by the owning control thread in "
+           "start()/stop(); the thread itself only fills the "
+           "arbiter's waived stage_cache dict"),
     # module-level globals (cls="" and attr=global name)
     Waiver("spark_tpu/testing/faults.py", "", "_PLAN",
            "atomic reference rebind at execute_batch entry / test "
@@ -257,6 +285,11 @@ WAIVERS: Tuple[Waiver, ...] = (
     Waiver(_SVC + "arbiter.py", "", "_ARBITER",
            "atomic reference rebind at service start/stop, before "
            "worker threads exist / after they drained"),
+    Waiver("spark_tpu/execution/compile_cache.py", "", "_CACHES",
+           "GIL-atomic dict get/set; a racing duplicate CompileCache "
+           "for one dir is equivalent — all writes go through atomic "
+           "renames and reads tolerate concurrent eviction, the two "
+           "instances' locks merely guard their own bookkeeping"),
     Waiver("spark_tpu/testing/lockwatch.py", "LockWatch", "_installed",
            "mutated only by the test harness thread during "
            "install()/uninstall(), before/after the watched "
@@ -365,6 +398,8 @@ EXTRA_EDGES: Tuple[Tuple[str, str, str], ...] = (
      "end"),
     ("service.session", "faults.plan", "chaos seams fire during "
      "execution"),
+    ("service.session", "execution.compile_cache", "stage compiles "
+     "publish serialized executables under the lease"),
     ("service.session", "metrics.registry", "metric lookups during "
      "execution"),
     ("service.session", "metrics.flush", "sink flush at query end"),

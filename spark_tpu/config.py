@@ -790,6 +790,45 @@ STREAMING_FILE_STRICT = register(
         "(at-least-once delivery of every file byte wins over "
         "availability).")
 
+COMPILE_CACHE_ENABLED = register(
+    "spark_tpu.sql.compileCache.enabled", False,
+    doc="Persistent cross-process AOT compile cache "
+        "(execution/compile_cache.py): on an in-memory stage-cache "
+        "miss, compile the stage through the AOT path, serialize the "
+        "executable and write it under compileCache.dir; a later "
+        "PROCESS's miss of the same (stage key, environment "
+        "fingerprint, call signature) deserializes instead of "
+        "compiling — a warm serving process never jits a known shape "
+        "twice. Entries are atomic-rename published and a "
+        "corrupt/truncated entry falls back to a fresh compile "
+        "(compile_cache_corrupt), never failing the query. The "
+        "CodeGenerator-cache seat, made cross-process (SURVEY §7: XLA "
+        "compile time is the new Janino compile time).")
+
+COMPILE_CACHE_DIR = register(
+    "spark_tpu.sql.compileCache.dir", "spark-compile-cache",
+    doc="Directory for the persistent compile cache: cc-<hash>.pkl "
+        "serialized executables + manifest.jsonl (the warm-start "
+        "replay log) + xla/ (JAX's native compilation cache, wired as "
+        "the secondary seat when unset by the operator). Empty "
+        "disables the cache even when compileCache.enabled is true.")
+
+COMPILE_CACHE_MAX_BYTES = register(
+    "spark_tpu.sql.compileCache.maxBytes", 1 << 30,
+    doc="Size bound for the compile-cache directory's entry files, "
+        "LRU-evicted by mtime (loads touch their entry, so hot shapes "
+        "survive). The just-written entry is never its own victim. "
+        "0 = unbounded.")
+
+COMPILE_CACHE_WARM_START = register(
+    "spark_tpu.sql.compileCache.warmStart", True,
+    doc="SQL-service warm start: when the compile cache is enabled, "
+        "SqlService.start() replays the manifest of recently-seen "
+        "stage keys into the sessions-shared stage cache, so a "
+        "restarted serving process opens hot (deserialization only — "
+        "no compiles). session.warmup() is the explicit per-session "
+        "form and ignores this flag.")
+
 MESH_SIZE = register(
     "spark_tpu.sql.mesh.size", 0,
     doc="Number of devices on the data axis of the SPMD mesh. 0 or 1 "

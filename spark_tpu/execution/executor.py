@@ -511,20 +511,30 @@ class QueryExecution:
                         "spark_tpu.sql.metrics.sink"))
                     or self._oom_rung > 0)
 
-    def _capture_stage_cost(self, fn, key: str, args) -> Optional[dict]:
+    def _capture_stage_cost(self, fn, key: str, args,
+                            compiled=None) -> Optional[dict]:
         """cost_analysis()/memory_analysis() per stage key, memoized on
         the session (a stage recompiles only when its key changes, so
         the analysis stays valid). Fault injection is suppressed around
         the analysis lowering: it re-traces the stage, and trace-time
-        chaos sites must count once per REAL compile."""
+        chaos sites must count once per REAL compile. When a `Compiled`
+        is already in hand (the AOT compile-cache path, or a wrapper
+        holding one for these args), it is analyzed directly — no
+        second analysis compile."""
         import hashlib
         from ..observability import xla_cost
         from ..testing import faults
+        from . import compile_cache as CC
         info = self.session._stage_costs.get(key)
         if info is None and args is not None and self._observe_cost():
+            if compiled is None and isinstance(fn, CC.CachedStageFn):
+                compiled = fn.compiled_for(args)
             t0 = time.perf_counter()
-            with faults.suppressed():
-                info = xla_cost.analyze_jit(fn, args)
+            if compiled is not None:
+                info = xla_cost.analyze_compiled(compiled)
+            else:
+                with faults.suppressed():
+                    info = xla_cost.analyze_jit(fn, args)
             info["analysis_ms"] = round(
                 (time.perf_counter() - t0) * 1e3, 1)
             info["key_hash"] = hashlib.md5(
@@ -626,9 +636,48 @@ class QueryExecution:
     def _compile_stage(self, root: P.PhysicalPlan, mesh=None, args=None):
         from ..observability.listener import StageCompiledEvent
         from ..testing import faults
+        from . import compile_cache as CC
         key = self._stage_key(root, mesh)
         self._last_stage_key = key  # recovery evicts exactly this entry
+        cc = CC.get_cache(self._conf) if args is not None else None
+        if cc is not None:
+            plan = faults.active()
+            if plan is not None and any(
+                    r.site in faults.TRACE_TIME_SITES
+                    for r in plan.rules):
+                # trace-time chaos seams fire once per (re)compile; a
+                # deserialized executable involves no trace, so the
+                # armed rule's nth hit would silently never arrive
+                # (and a transient-retry eviction would stop forcing
+                # the re-trace the seam contract documents). Chaos
+                # determinism wins: bypass the disk cache while such
+                # rules are armed.
+                cc = None
         fn = self.session._stage_cache.get(key)
+        partial = None
+        if fn is not None and isinstance(fn, CC.CachedStageFn):
+            if not fn.has_builder:
+                # warm-start entries arrive builder-less; bind the jit
+                # fallback here (only the executor owns the plan) so a
+                # novel call signature can still compile. The thunk
+                # closes over the PRE-BUILT stage fn (conf + plan
+                # only) — never `self`: these wrappers live in the
+                # session-lifetime shared stage cache, and capturing
+                # the QueryExecution would pin its recovery memo's
+                # materialized batches per cached key
+                stage_fn = self._build_stage_fn(root, mesh)
+                fn.bind_builder(lambda: jax.jit(stage_fn))
+            if cc is not None and fn.compiled_for(args) is None:
+                # the KEY is warm but THIS call signature is not
+                # (another dictionary encoding / batch shape): the
+                # disk may already hold its executable from another
+                # process or an earlier run — fall through to fill
+                # the existing wrapper, so the "never jit a known
+                # shape twice" contract holds per SIGNATURE, not
+                # merely per key (and a fresh compile here gets
+                # persisted instead of hiding in the jit fallback)
+                partial = fn
+                fn = None
         if fn is not None:
             self.session.metrics.counter("compile_cache_hits").inc()
             self._capture_stage_cost(fn, key, args)
@@ -640,16 +689,60 @@ class QueryExecution:
         faults.fire("stage_compile")  # chaos seam: pre-jit, cache miss
         if mesh is not None:
             faults.fire("mesh")  # chaos seam: mesh/shard_map lowering
-        fn = jax.jit(self._build_stage_fn(root, mesh))
+        compiled = None
+        disk_hit = False
+        if cc is not None:
+            # persistent cross-process seat: deserialize instead of
+            # compiling when a matching executable is on disk
+            t_deser = time.perf_counter()
+            compiled = cc.load(key, mesh, args,
+                               metrics=self.session.metrics)
+            if compiled is not None:
+                disk_hit = True
+                self.spans.record("deserialize", t_deser,
+                                  time.perf_counter())
+        if cc is not None:
+            # either cc branch pays compile/deserialize EAGERLY here,
+            # so the first dispatch carries no jit compile — the
+            # dispatch span's includes_jit_compile flag must not
+            # attribute cost this span already carries
+            self._last_compile_was_miss = False
+        if compiled is not None:
+            if partial is not None:
+                fn = partial
+            else:
+                # builder closes over the pre-built stage fn only (see
+                # the warm-start bind above for why `self` must not
+                # leak in)
+                stage_fn = self._build_stage_fn(root, mesh)
+                fn = CC.CachedStageFn(lambda: jax.jit(stage_fn))
+            fn.add(CC.call_signature(args), compiled)
+        elif cc is not None:
+            # AOT path: pay trace + backend compile NOW (the lazy jit
+            # would pay the same at first dispatch) so the executable
+            # can be serialized for the next process
+            jitted = jax.jit(self._build_stage_fn(root, mesh))
+            compiled = jitted.lower(*args).compile()
+            cc.store(key, mesh, args, compiled,
+                     metrics=self.session.metrics)
+            fn = partial if partial is not None \
+                else CC.CachedStageFn(lambda: jitted)
+            fn.add(CC.call_signature(args), compiled)
+        else:
+            fn = jax.jit(self._build_stage_fn(root, mesh))
         self.session._stage_cache[key] = fn
-        cost = self._capture_stage_cost(fn, key, args)
+        cost = self._capture_stage_cost(fn, key, args, compiled=compiled)
         t1 = time.perf_counter()
         # honesty note: jax.jit is lazy — the EXECUTING program's XLA
         # compile happens inside the first dispatch (that dispatch span
-        # carries includes_jit_compile=True). This span covers stage
+        # carries includes_jit_compile=True). Under the compile cache
+        # the AOT path is EAGER, so this span carries the true compile
+        # (or deserialize) cost. Without it, the span covers stage
         # setup plus, when capture is on, the AOT analysis compile
         # (whose wall-clock rides in the analysis_ms attr).
         attrs = {"stage": (cost or {}).get("key_hash", key[:60])}
+        if cc is not None:
+            attrs["disk_hit"] = disk_hit
         if cost and cost.get("analysis_ms") is not None:
             attrs["analysis_ms"] = cost["analysis_ms"]
         self.spans.record("compile", t_compile, t1, **attrs)

@@ -76,6 +76,14 @@ METRIC_PREFIXES = (
     "streaming_",      # streaming_batches/_rows/_state_delta_bytes/
                        # _state_snapshot_bytes/_restore_ms/
                        # _files_quarantined/_log_corrupt
+    # compiled-stage caches (executor + execution/compile_cache.py):
+    # REGISTRY counters, listed for namespace closure — in-memory
+    # hits/misses plus the persistent cross-process seat's disk
+    # hits/misses, deserialize wall-clock, bytes written, corrupt
+    # entries recovered from, and warm-start entries installed
+    "compile_cache_",  # compile_cache_hits/_misses/_disk_hits/
+                       # _disk_misses/_deser_ms/_write_bytes/
+                       # _corrupt/_warm_entries
 )
 
 

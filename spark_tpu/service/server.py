@@ -158,6 +158,9 @@ class SqlService:
         # path
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
+        #: background compile-cache warm-start replay (start() spawns
+        #: it AFTER the socket binds; stop() joins it bounded)
+        self._warm_thread: Optional[threading.Thread] = None
 
     # -- service event stream ----------------------------------------------
 
@@ -508,8 +511,15 @@ class SqlService:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "SqlService":
-        """Install the arbiter (when hbmBudget > 0) and serve HTTP on
-        service.{host,port} from a daemon thread."""
+        """Install the arbiter (when hbmBudget > 0), serve HTTP on
+        service.{host,port} from a daemon thread, then warm-start the
+        sessions-shared stage cache from the persistent compile cache
+        (compileCache.{enabled,warmStart}) on a BACKGROUND thread — a
+        restarted serving process opens hot (deserialization instead
+        of XLA compiles) without delaying the socket bind: a full
+        manifest replay must never hold /healthz at
+        connection-refused. Queries racing the replay just compile as
+        usual (the stage cache fills under them either way)."""
         self._ensure_arbiter()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer(
@@ -520,6 +530,18 @@ class SqlService:
             target=self._httpd.serve_forever, daemon=True,
             name="sql-service-http")
         self._serve_thread.start()
+        from ..execution import compile_cache as CC
+        if bool(self.conf.get(CC.WARM_START_KEY)) \
+                and CC.get_cache(self.conf) is not None:
+            def warm():
+                n = CC.warm_start(self.arbiter.stage_cache, self.conf,
+                                  self.metrics)
+                if n:
+                    self.metrics.gauge("service_warm_stages").set(n)
+
+            self._warm_thread = threading.Thread(
+                target=warm, daemon=True, name="sql-service-warmstart")
+            self._warm_thread.start()
         return self
 
     @property
@@ -537,6 +559,9 @@ class SqlService:
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=10)
             self._serve_thread = None
+        if self._warm_thread is not None:
+            self._warm_thread.join(timeout=30)
+            self._warm_thread = None
         with self._install_lock:
             if self._installed_arbiter:
                 install_arbiter(None)

@@ -125,6 +125,18 @@ class SparkTpuSession(metaclass=_ActiveSessionMeta):
     addListener = add_listener
     removeListener = remove_listener
 
+    def warmup(self) -> int:
+        """Warm-start the in-memory stage cache from the persistent
+        compile cache (execution/compile_cache.py): replay the
+        manifest of recently-seen stage keys, deserializing each
+        entry whose environment fingerprint matches this process —
+        deserialization only, no compiles. Returns entries installed
+        (0 when spark_tpu.sql.compileCache.enabled is off). The
+        SQL service calls the pooled equivalent at startup
+        (compileCache.warmStart)."""
+        from .execution.compile_cache import warm_start
+        return warm_start(self._stage_cache, self.conf, self.metrics)
+
     def decommission_shards(self, shards) -> None:
         """Gracefully drain the given mesh positions (elastic mesh,
         parallel/elastic.py): a running mesh stream checkpoints at its

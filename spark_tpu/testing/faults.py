@@ -18,7 +18,7 @@ Grammar (comma-separated rules):
              | ingest_prefetch | shard_chunk | mesh_restart
              | decommission | stream_source_list
              | stream_offset_write | stream_state_commit
-             | stream_sink_emit
+             | stream_sink_emit | compile_cache_load
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
     nth   := 1-based hit count of `site` at which the rule fires
@@ -69,6 +69,13 @@ range lands in the offset log, `stream_state_commit` at every state
 durability chaos matrix (tests/test_streaming_durability.py) kills a
 query at each seam, discards the object, and proves a fresh
 StreamingQuery over the same checkpoint recovers exactly-once.
+
+`compile_cache_load` fires inside the persistent compile cache's
+guarded entry load (execution/compile_cache.py), once per existing
+entry consulted: an armed rule models a corrupted/truncated entry (or
+a backend deserialize rejection), and the contract under ANY failure
+there is log + count (`compile_cache_corrupt`) + fresh compile +
+overwrite — a damaged cache never fails a query.
 """
 
 from __future__ import annotations
@@ -91,7 +98,17 @@ KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "ingest_prefetch", "shard_chunk", "mesh_restart",
                "decommission", "stream_source_list",
                "stream_offset_write", "stream_state_commit",
-               "stream_sink_emit")
+               "stream_sink_emit", "compile_cache_load")
+
+#: sites that fire INSIDE a stage trace (once per (re)compile of the
+#: enclosing stage). The persistent compile cache consults this: a
+#: deserialized executable involves no trace, so while a plan with
+#: rules on these sites is armed, `_compile_stage` bypasses the disk
+#: cache entirely — chaos determinism (retry re-traces, the rule's
+#: nth hit arrives) wins over caching, and no plan is ever armed in
+#: production. (`mesh` fires host-side in _compile_stage itself, and
+#: scan_load/stage_run per pass — only these two are trace-bound.)
+TRACE_TIME_SITES = ("shuffle", "join_build")
 
 #: test-registered extra seams (register_site): code under test may
 #: plant its own fire() points without editing the built-in tuple.
