@@ -82,7 +82,11 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
         if not isinstance(e.get(key), types):
             _problem(out, path, lineno,
                      f"field {key!r} missing or not {types}")
-    if e.get("status") not in ("ok", "error"):
+    # "cancelled"/"deadline_exceeded": lifecycle-control stops
+    # (execution/lifecycle.py), written by the executor's query-end
+    # event next to ok/error
+    if e.get("status") not in ("ok", "error", "cancelled",
+                               "deadline_exceeded"):
         _problem(out, path, lineno, f"bad status {e.get('status')!r}")
     phases = e.get("phase_times_s")
     if phases is not None and (

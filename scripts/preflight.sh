@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Thirteen stages, all mandatory:
+# Fourteen stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -74,6 +74,12 @@
 #      with ZERO disk misses (no backend recompiles of cached shapes)
 #      and byte-identical results, plus a corrupted-entry run proving
 #      the compile_cache_corrupt fallback never fails the query
+#  14. cancellation smoke: start a chunked TPC-H Q3 via the service,
+#      DELETE it mid-stream, and assert the structured QUERY_CANCELLED
+#      record, no leaked prefetch daemon (assert_no_thread_leak), the
+#      arbiter lease pool drained to idle, and an immediate identical
+#      re-run at golden parity — the query-lifecycle hard guarantee
+#      (execution/lifecycle.py) end to end over HTTP
 #
 # Usage: scripts/preflight.sh [--fast]
 #   --fast skips the full pytest suite (stages 2-13 still run) for
@@ -89,7 +95,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/13: tier-1 test suite --"
+    echo "-- stage 1/14: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -103,16 +109,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/13: SKIPPED (--fast) --"
+    echo "-- stage 1/14: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/13: dryrun_multichip(8) --"
+echo "-- stage 2/14: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/13: bench smoke --"
+echo "-- stage 3/14: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -144,7 +150,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/13: chaos smoke --"
+echo "-- stage 4/14: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -198,7 +204,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/13: observability + analysis smoke --"
+echo "-- stage 5/14: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -291,10 +297,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/13: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/14: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/13: SQL service smoke --"
+echo "-- stage 7/14: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -368,7 +374,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/13: join-kernel + ingest parity smoke --"
+echo "-- stage 8/14: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -426,7 +432,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/13: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/14: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -470,7 +476,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/13: elastic mesh smoke --"
+echo "-- stage 10/14: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -520,7 +526,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/13: streaming durability smoke --"
+echo "-- stage 11/14: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -613,7 +619,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/13: concurrency smoke --"
+echo "-- stage 12/14: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -696,7 +702,7 @@ print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
 
-echo "-- stage 13/13: compile-cache smoke --"
+echo "-- stage 13/14: compile-cache smoke --"
 # Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
 # subprocess over the same dir must open warm (disk_hits >= 1, ZERO
 # disk misses = no backend recompiles of cached shapes) with
@@ -792,5 +798,101 @@ print(json.dumps({"preflight_compile_cache_smoke": "ok",
                   "warm_disk_hits": warm["disk_hits"],
                   "corrupt_recovered": fixed["corrupt"]}))
 EOF11
+
+echo "-- stage 14/14: query-lifecycle cancellation smoke --"
+# Start a chunked Q3 via the service, DELETE it mid-stream, assert the
+# structured error + no thread leak + arbiter drained + an immediate
+# clean re-run at golden parity (the cancellation hard guarantee).
+env JAX_PLATFORMS=cpu python - <<'EOF12'
+import json
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pandas as pd
+
+from spark_tpu import Conf
+from spark_tpu.service.server import SqlService
+from spark_tpu.testing.lockwatch import LockWatch
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch import sql_queries as SQLQ
+from spark_tpu.tpch.datagen import write_parquet
+
+path = tempfile.mkdtemp(prefix="preflight_lifecycle_") + "/sf"
+write_parquet(path, 0.002)
+
+conf = Conf()
+conf.set("spark_tpu.service.port", 0)
+conf.set("spark_tpu.service.hbmBudget", 1 << 30)
+svc = SqlService(conf,
+                 init_session=lambda s: Q.register_tables(s, path)).start()
+
+
+def post(body, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}/sql",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+try:
+    # async chunked Q3 held mid-stream by an interruptible slow fault
+    # (a >=10s uncancelled floor), then DELETE while running
+    status, body = post({
+        "sql": SQLQ.Q3, "mode": "async",
+        "conf": {"spark_tpu.sql.execution.streamingChunkRows": 512,
+                 "spark_tpu.sql.memory.deviceBudget": 1,
+                 "spark_tpu.faults.inject": "stream_chunk:slow:2:10000"}})
+    assert status == 202, (status, body)
+    rid = body["query_id"]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        rec = svc.query_snapshot(rid)
+        if rec.get("status") == "running":
+            break
+        time.sleep(0.01)
+    time.sleep(0.3)  # into the chunk loop / slow sleep
+    t0 = time.perf_counter()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}/queries/{rid}", method="DELETE")
+    resp = json.load(urllib.request.urlopen(req, timeout=30))
+    assert resp["status"] == "cancel_requested", resp
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        rec = svc.query_snapshot(rid)
+        if rec.get("status") not in ("submitted", "running"):
+            break
+        time.sleep(0.02)
+    latency_s = time.perf_counter() - t0
+    assert rec["status"] == "cancelled", rec
+    assert rec["error"]["error"] == "QUERY_CANCELLED", rec
+    assert latency_s < 5.0, f"cancel took {latency_s:.2f}s"
+    # hard guarantee: no daemon outlives the query, leases drained
+    LockWatch().assert_no_thread_leak(timeout_s=10.0)
+    arb = svc.arbiter.stats()
+    assert arb["leased_bytes"] == 0 and arb["owners"] == 0, arb
+    # immediate identical re-run (chaos disarmed): golden parity
+    status, again = post({
+        "sql": SQLQ.Q3,
+        "conf": {"spark_tpu.faults.inject": "",
+                 "spark_tpu.sql.memory.deviceBudget": 0}})
+    assert status == 200, (status, again)
+    got = pd.DataFrame(again["rows"], columns=again["columns"])
+    want = G.GOLDEN["q3"](path)
+    G.compare(G.normalize_decimals(got)[list(want.columns)]
+              .reset_index(drop=True), want.reset_index(drop=True))
+    assert svc.metrics.counter("query_cancelled").value >= 1
+finally:
+    svc.stop()
+print(json.dumps({"preflight_cancellation_smoke": "ok",
+                  "cancel_latency_s": round(latency_s, 3)}))
+EOF12
 
 echo "== preflight PASSED =="

@@ -94,6 +94,10 @@ LOCKS: Tuple[LockDecl, ...] = (
              "(outermost — everything below may nest inside it)"),
     LockDecl("service.pool", _SVC + "pool.py", "SessionPool", "_lock",
              "lock", 14, "session-pool entry map"),
+    LockDecl("service.quota", _SVC + "admission.py", "SessionQuota",
+             "_lock", "lock", 16,
+             "per-session in-flight quota counters; check-and-inc "
+             "only, rejection bookkeeping runs outside it"),
     LockDecl("service.admission", _SVC + "admission.py",
              "AdmissionController", "_cv", "condition", 18,
              "execution-slot gate (cv: queued requests wait here)"),
@@ -104,6 +108,12 @@ LOCKS: Tuple[LockDecl, ...] = (
     LockDecl("service.install", _SVC + "server.py", "SqlService",
              "_install_lock", "lock", 24,
              "one-shot arbiter installation guard"),
+    LockDecl("execution.lifecycle", "spark_tpu/execution/lifecycle.py",
+             "", "_TOKENS_LOCK", "lock", 26,
+             "cancel-token registry ((app_id, query_id) -> token): "
+             "registered by the executor under the session lease, "
+             "cancelled from any thread; dict ops only inside — "
+             "token.cancel() (an Event.set) runs outside it"),
     LockDecl("service.arbiter", _SVC + "arbiter.py",
              "DeviceResourceArbiter", "_cv", "condition", 30,
              "HBM lease pool (cv: denied leases wait for releases)"),
@@ -194,11 +204,15 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
               "_cv"),
     GuardDecl(_SVC + "admission.py", "AdmissionController", "queued",
               "_cv"),
+    GuardDecl(_SVC + "admission.py", "SessionQuota", "_inflight",
+              "_lock"),
     # pool / server / history
     GuardDecl(_SVC + "pool.py", "SessionPool", "_entries", "_lock"),
     GuardDecl(_SVC + "server.py", "SqlService", "_records",
               "_records_lock"),
     GuardDecl(_SVC + "server.py", "SqlService", "_seq", "_records_lock"),
+    GuardDecl(_SVC + "server.py", "SqlService", "_tokens",
+              "_records_lock"),
     GuardDecl(_SVC + "server.py", "SqlService", "_async_inflight",
               "_async_lock"),
     GuardDecl(_SVC + "server.py", "SqlService", "_installed_arbiter",
@@ -227,6 +241,9 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
               "lock_stats", "_mu"),
     # config (module-level global)
     GuardDecl("spark_tpu/config.py", "", "_REGISTRY", "_REGISTRY_LOCK"),
+    # lifecycle token registry (module-level global)
+    GuardDecl("spark_tpu/execution/lifecycle.py", "", "_TOKENS",
+              "_TOKENS_LOCK"),
 )
 
 #: intentionally-unguarded state, each with the reason the race is
@@ -338,6 +355,7 @@ RECEIVER_ATTRS: Dict[str, str] = {
     "_metrics": "MetricsRegistry",
     "admission": "AdmissionController",
     "_ctl": "AdmissionController",
+    "session_quota": "SessionQuota",
     "arbiter": "DeviceResourceArbiter",
     "result_cache": "ResultCache",
     "history": "QueryHistoryStore",
@@ -419,6 +437,16 @@ EXTRA_EDGES: Tuple[Tuple[str, str, str], ...] = (
     # register on its (new) bus
     ("service.pool", "obs.bus", "SessionPool._create -> "
      "session.add_listener under the pool lock"),
+    # the executor registers its cancel token while the session lease
+    # is held (lifecycle.enter_query_scope from execute_batch)
+    ("service.session", "execution.lifecycle", "executor registers "
+     "the query's cancel token under the lease"),
+    # admission/arbiter cv waits run lifecycle.checkpoint each wakeup,
+    # which fires the cancel_point chaos seam (faults.plan counting)
+    ("service.admission", "faults.plan", "queue-wait wakeups fire the "
+     "cancel_point seam while holding the slot cv"),
+    ("service.arbiter", "faults.plan", "lease-wait wakeups fire the "
+     "cancel_point seam while holding the lease cv"),
 )
 
 

@@ -248,6 +248,21 @@ EXEC_STAGE_TIMEOUT_MS = register(
         "sync. A blown deadline raises StageTimeoutError and retries "
         "under the maxRetries budget. 0 disables.")
 
+EXEC_QUERY_DEADLINE_MS = register(
+    "spark_tpu.execution.queryDeadlineMs", 0.0,
+    doc="End-to-end query deadline in milliseconds, armed on the "
+        "cooperative cancel token (execution/lifecycle.py) at "
+        "execution entry (at SERVICE SUBMIT entry for POST /sql, so "
+        "admission-queue and session waits count against the budget; "
+        "per-request override via the request's conf map). Every "
+        "downstream wait — stage attempts, retry backoff, admission "
+        "queue, arbiter lease, chunk boundaries — is capped by the "
+        "remaining budget; a blown deadline raises the structured "
+        "QueryDeadlineError, which STOPS the recovery ladder instead "
+        "of retrying through it (distinct from the per-stage "
+        "stageTimeoutMs TIMEOUT class). 0 disables.",
+    validator=lambda v: v >= 0)
+
 CHUNK_RETRY_ENABLED = register(
     "spark_tpu.execution.chunkRetry.enabled", True,
     doc="Chunk-granular retry inside the streaming drivers "
@@ -740,6 +755,29 @@ SERVICE_MAX_SESSIONS = register(
         "distinct `session` name in POST /sql). A request naming a new "
         "session past the bound is rejected with a structured error.",
     validator=lambda v: v >= 1)
+
+SERVICE_SESSION_MAX_CONCURRENT = register(
+    "spark_tpu.service.session.maxConcurrent", 0,
+    doc="Per-session admission quota: maximum in-flight submissions "
+        "(running + waiting, sync and async) a single session name may "
+        "hold at once. Exceeding it rejects with a structured "
+        "SESSION_QUOTA_EXCEEDED error (HTTP 429) and counts "
+        "session_quota_rejections — one greedy session cannot consume "
+        "every admission-queue slot and starve the pool. 0 disables "
+        "(service-wide maxConcurrent/queueDepth still bound totals).",
+    validator=lambda v: v >= 0)
+
+SERVICE_SESSION_HBM_SHARE = register(
+    "spark_tpu.service.session.hbmShare", 0.0,
+    doc="Per-session share of the service.hbmBudget arbiter pool "
+        "(fraction, 0 < share <= 1): one session's residency leases "
+        "may not exceed share * hbmBudget in total. A scan whose lease "
+        "would push its session past the share is DENIED immediately "
+        "(counted in session_quota_rejections) and takes the "
+        "out-of-core spill/streaming paths — degraded, never starved, "
+        "and the rest of the pool stays available to other sessions. "
+        "0 disables the share cap.",
+    validator=lambda v: 0 <= v <= 1)
 
 SERVICE_QUERY_LOG_SIZE = register(
     "spark_tpu.service.queryLogSize", 512,

@@ -637,6 +637,9 @@ class QueryExecution:
         from ..observability.listener import StageCompiledEvent
         from ..testing import faults
         from . import compile_cache as CC
+        from . import lifecycle
+        # cooperative boundary before paying (or re-paying) a compile
+        lifecycle.checkpoint("compile")
         key = self._stage_key(root, mesh)
         self._last_stage_key = key  # recovery evicts exactly this entry
         cc = CC.get_cache(self._conf) if args is not None else None
@@ -961,6 +964,7 @@ class QueryExecution:
         from ..testing import faults
         from .failures import RetryPolicy
         from .recovery import RecoveryContext
+        from . import lifecycle
         self._activate_conf()
         # degraded-mode state was sticky across executions of one
         # QueryExecution: a warm-loop re-execution after a transient
@@ -973,6 +977,13 @@ class QueryExecution:
         self._exec_conf = None
         self._mesh_fallback = False
         faults.arm(self.session.conf)
+        # query lifecycle scope (execution/lifecycle.py): install a
+        # cancel token (deadline armed from queryDeadlineMs) unless an
+        # outer scope — the SQL service, or an enclosing execution
+        # whose subquery this is — already did, and register it for
+        # session.cancel(query_id)
+        lc_scope = lifecycle.enter_query_scope(
+            self.session.app_id, self.query_id, self.session.conf)
         # cross-query arbiter lease scope (service/arbiter.py): scans
         # this execution keeps resident lease from the shared HBM pool;
         # everything leased is released when the execution ends. None
@@ -1016,11 +1027,16 @@ class QueryExecution:
             return self._execute_recover()
         except _ReplanRequest:
             raise
+        except (lifecycle.QueryCancelledError,
+                lifecycle.QueryDeadlineError) as e:
+            self._observe_cancel(e)
+            raise
         except Exception as e:  # noqa: BLE001 — observe, then surface
             self._post_query_end(None, status="error", error=e)
             raise
         finally:
             res_arbiter.exit_query(arb_token)
+            lifecycle.exit_query_scope(lc_scope)
             self.session._exec_depth -= 1
             if self._recovery is not None:
                 # the memo spans recovery loops, not executions: drop
@@ -1072,6 +1088,24 @@ class QueryExecution:
                 query_id=self.query_id, ts=time.time(), action=action,
                 error=error, site=site))
 
+    def _observe_cancel(self, e: Exception) -> None:
+        """Observability for a cancelled/deadlined execution: the
+        lifecycle counter, a `cancel` action in fault_summary (history
+        FAULT_ACTIONS), a `cancelled` instant span in the Chrome
+        trace, and a query-end event whose status ("cancelled" /
+        "deadline_exceeded") flows into the event log and the
+        service's query-history store."""
+        from .lifecycle import QueryCancelledError
+        cancelled = isinstance(e, QueryCancelledError)
+        status = "cancelled" if cancelled else "deadline_exceeded"
+        self.session.metrics.counter(
+            "query_cancelled" if cancelled
+            else "query_deadline_exceeded").inc()
+        self._record_fault("cancel", e)
+        self.spans.mark("cancelled",
+                        reason="cancel" if cancelled else "deadline")
+        self._post_query_end(None, status=status, error=e)
+
     def _mesh_replan(self, mesh_size: Optional[int] = None) -> None:
         """Shared reset for the elastic-ladder rungs that change the
         gang's shape (drain, shrink-on-restart, single-device
@@ -1091,8 +1125,13 @@ class QueryExecution:
         """Run `_execute_batch_inner` under the failure taxonomy: each
         iteration either returns, re-raises (_ReplanRequest, FATAL,
         exhausted budgets), or applies one recovery action and loops."""
+        from . import lifecycle
         last: Optional[Exception] = None
         for _ in range(32):  # every action below consumes a bounded budget
+            # cooperative boundary at every stage-attempt entry: a
+            # cancel/deadline delivered mid-recovery stops the ladder
+            # here instead of burning another recovery action
+            lifecycle.checkpoint("stage_attempt")
             try:
                 return self._execute_batch_inner()
             except _ReplanRequest:
@@ -1116,6 +1155,12 @@ class QueryExecution:
         conf = self._conf
         cls = classify(e)
         msg = f"{type(e).__name__}: {e}"
+
+        # lifecycle control outranks every recovery rung: a cancelled
+        # or deadlined query surfaces unchanged — no retry, no
+        # degraded re-plan, no gang restart (execution/lifecycle.py)
+        if cls is FailureClass.CANCELLED:
+            raise
 
         # graceful decommission (parallel/elastic.py): a drain request
         # surfaced at a chunk boundary — a planned transition, not a
@@ -1396,6 +1441,9 @@ class QueryExecution:
         self._collect_scans(root, scans)
 
         t0 = time.perf_counter()
+        from . import lifecycle
+        # cooperative boundary before host ingest loads the scans
+        lifecycle.checkpoint("scan")
         from ..io.device_cache import load_scan
         # dedupe by node identity: a runtime filter's creation chain
         # shares its leaf with the join build side (the documented DAG),
@@ -1460,6 +1508,12 @@ class QueryExecution:
                     attempt=_attempt,
                     includes_jit_compile=getattr(
                         self, "_last_compile_was_miss", False))
+                # deadline BEFORE the stage-timeout check: an attempt
+                # that outran the end-to-end budget raises the
+                # lifecycle error (ladder stops), never a retryable
+                # StageTimeoutError — queryDeadlineMs < stageTimeoutMs
+                # must not retry through the recovery ladder
+                lifecycle.checkpoint("post_dispatch")
                 if timeout_ms > 0:
                     att_ms = (time.perf_counter() - t_att) * 1e3
                     if att_ms > timeout_ms:
@@ -1814,15 +1868,29 @@ class QueryExecution:
         # must stay held while the resident execution actually uses the
         # bytes (the inner enter_query calls nest onto this owner).
         from ..service import arbiter as res_arbiter
+        from . import lifecycle
         arb_token = res_arbiter.enter_query(
             f"{self.session.app_id}:q{self.query_id}")
+        # lifecycle scope spans the external-collect gate too, so a
+        # cancel lands between chunks of the out-of-core egress path
+        # (execute_batch nests inside this scope, sharing the token)
+        lc_scope = lifecycle.enter_query_scope(
+            self.session.app_id, self.query_id, self.session.conf)
         try:
-            ext = self._try_external_collect()
+            try:
+                ext = self._try_external_collect()
+            except (lifecycle.QueryCancelledError,
+                    lifecycle.QueryDeadlineError) as e:
+                # the external path never reaches execute_batch's
+                # except: observe here (counter + fault record + event)
+                self._observe_cancel(e)
+                raise
             if ext is not None:
                 return ext
             batch, _, _ = self.execute_batch()
             return batch.to_arrow()
         finally:
+            lifecycle.exit_query_scope(lc_scope)
             res_arbiter.exit_query(arb_token)
 
     def _try_external_collect(self) -> Optional[pa.Table]:

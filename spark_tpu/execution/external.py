@@ -176,18 +176,24 @@ def try_external_collect(session, plan: P.PhysicalPlan, conf,
     total_rows = 0
     ci = 0
     b = first
-    while b is not None:
-        t = retrier.run(lambda bb=b: run_chunk(bb).to_arrow(), chunk=ci)
-        spilled.append(t)
-        total_rows += t.num_rows
-        if limit is not None and sort is None and total_rows >= limit.n:
-            break  # plain LIMIT: enough live rows spilled
-        ci += 1
-        b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
-    if hasattr(chunks, "close"):
-        # early LIMIT break: release the prefetch worker (it may hold
-        # one decoded chunk against a full queue)
-        chunks.close()
+    try:
+        while b is not None:
+            t = retrier.run(lambda bb=b: run_chunk(bb).to_arrow(),
+                            chunk=ci)
+            spilled.append(t)
+            total_rows += t.num_rows
+            if limit is not None and sort is None \
+                    and total_rows >= limit.n:
+                break  # plain LIMIT: enough live rows spilled
+            ci += 1
+            b = next(chunks, None)  # ingest un-retried: see ChunkRetrier
+    finally:
+        if hasattr(chunks, "close"):
+            # early LIMIT break, a fault, or a cancellation unwinding
+            # mid-stream: release + JOIN the prefetch worker (it may
+            # hold one decoded chunk against a full queue) — no ingest
+            # daemon may outlive its query
+            chunks.close()
 
     table = pa.concat_tables(spilled, promote_options="permissive")
 

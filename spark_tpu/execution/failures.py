@@ -20,6 +20,11 @@ structure:
 - OVERFLOW: static-capacity overflow. Never an exception — it flows as
   flags through the stats channel into the AQE re-jit loop; listed here
   so the taxonomy is total.
+- CANCELLED: lifecycle control (execution/lifecycle.py) — the query
+  was cancelled or blew its end-to-end queryDeadlineMs. NEVER retried,
+  never degraded: the recovery ladder re-raises immediately (a
+  deadline blown mid-recovery must stop the ladder, not retry through
+  it).
 - FATAL: everything else — surfaces immediately.
 
 Synthetic faults from `spark_tpu.testing.faults` carry their class on
@@ -30,7 +35,6 @@ through one path.
 from __future__ import annotations
 
 import random
-import time
 from enum import Enum
 from typing import Optional
 
@@ -40,6 +44,7 @@ class FailureClass(Enum):
     TIMEOUT = "timeout"
     OOM = "oom"
     OVERFLOW = "overflow"
+    CANCELLED = "cancelled"
     FATAL = "fatal"
 
 
@@ -81,6 +86,9 @@ def classify(exc: BaseException) -> FailureClass:
     """Map an exception to its failure class. Synthetic faults classify
     by their carried class; real errors by message tokens."""
     from ..testing.faults import FaultInjected
+    from .lifecycle import QueryCancelledError, QueryDeadlineError
+    if isinstance(exc, (QueryCancelledError, QueryDeadlineError)):
+        return FailureClass.CANCELLED
     if isinstance(exc, StageTimeoutError):
         return FailureClass.TIMEOUT
     if isinstance(exc, FaultInjected):
@@ -120,10 +128,17 @@ class RetryPolicy:
     loop (spark.task.maxFailures seat).
 
     delay_n = backoff_ms * 2^n * uniform(0.5, 1.0)
+
+    The default sleep is the INTERRUPTIBLE lifecycle wait
+    (execution/lifecycle.py): a backoff wakes immediately when the
+    query is cancelled and is capped by the remaining queryDeadlineMs
+    budget — raising the structured lifecycle error instead of
+    sleeping into a dead query. Pass an explicit `sleep` to opt out
+    (tests that count slept milliseconds do).
     """
 
     def __init__(self, max_retries: int, backoff_ms: float,
-                 sleep=time.sleep, rng: Optional[random.Random] = None):
+                 sleep=None, rng: Optional[random.Random] = None):
         self.max_retries = max(0, int(max_retries))
         self.remaining = self.max_retries
         self.backoff_ms = max(0.0, float(backoff_ms))
@@ -135,9 +150,17 @@ class RetryPolicy:
     def attempt_retry(self) -> Optional[float]:
         """Consume one retry and sleep the backoff. Returns the slept
         milliseconds, or None when the budget is exhausted (caller must
-        surface the error)."""
+        surface the error). Raises the structured lifecycle error when
+        the query was cancelled / deadlined — a retry of a dead query
+        must not consume budget or sleep."""
         if self.remaining <= 0:
             return None
+        from .lifecycle import checkpoint, sleep as _lc_sleep
+        # cooperative boundary BEFORE paying the backoff: the chaos
+        # matrix's retry-backoff delivery point
+        checkpoint("retry_backoff")
+        if self._sleep is None:
+            self._sleep = _lc_sleep
         delay_ms = self.backoff_ms * (2 ** self.attempts)
         delay_ms *= 0.5 + self._rng.random() * 0.5
         if delay_ms > 0:

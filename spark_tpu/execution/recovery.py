@@ -108,6 +108,12 @@ class ChunkRetrier:
 
     def run(self, step, chunk: int = 0):
         from ..testing import faults
+        from .lifecycle import checkpoint
+        # cooperative cancellation boundary: every chunk of every
+        # driver (streaming direct/spill/mesh + external collect)
+        # passes through here, so a cancel/deadline lands within one
+        # chunk of delivery (execution/lifecycle.py)
+        checkpoint("chunk")
         policy: Optional[RetryPolicy] = None
         orig: Optional[Exception] = None
         while True:
@@ -125,6 +131,10 @@ class ChunkRetrier:
                 if not self.enabled or self.max_retries <= 0:
                     raise
                 cls = classify(e)
+                if cls is FailureClass.CANCELLED:
+                    # lifecycle control, not a fault: never replayed,
+                    # and never laundered into a saved `orig` transient
+                    raise
                 if cls not in _RETRYABLE:
                     if orig is not None:
                         # the replay hit a secondary non-retryable error

@@ -317,6 +317,21 @@ def stream_scan_aggregate(agg: "P.HashAggregateExec", chain: List,
         leaf.source.load_chunks(leaf.required_columns,
                                 leaf.pushed_filters, chunk_rows),
         conf, recovery)
+    try:
+        return _stream_scan_aggregate_inner(agg, chain, conf, cache,
+                                            recovery, chunks,
+                                            chunk_rows)
+    finally:
+        # deterministic worker shutdown on EVERY exit — normal
+        # exhaustion, fallback `return None`, or an exception (fault,
+        # cancellation) unwinding mid-stream: no prefetch daemon may
+        # outlive its query (lockwatch assert_no_thread_leak)
+        if hasattr(chunks, "close"):
+            chunks.close()
+
+
+def _stream_scan_aggregate_inner(agg, chain, conf, cache, recovery,
+                                 chunks, chunk_rows):
     first = next(iter(chunks), None)
     if first is None:
         return None
@@ -441,8 +456,6 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
     mesh stream single-device: `skip_chunks` advances the chunk cursor
     past what the checkpoint already covers, and `seed_partials`
     prepends the checkpointed partial tables to the spill list."""
-    import copy
-    import pyarrow as pa
     from ..io.sources import maybe_prefetch
 
     chunk_rows = int(conf.get(CHUNK_ROWS_KEY))
@@ -450,6 +463,23 @@ def stream_scan_aggregate_spill(agg: "P.HashAggregateExec", chain: List,
         leaf.source.load_chunks(leaf.required_columns,
                                 leaf.pushed_filters, chunk_rows),
         conf, recovery)
+    try:
+        return _stream_scan_aggregate_spill_inner(
+            agg, chain, conf, cache, recovery, skip_chunks,
+            seed_partials, chunks, chunk_rows)
+    finally:
+        # join the prefetch worker on every exit (see
+        # stream_scan_aggregate): a cancelled/deadlined query must not
+        # leak its ingest daemon
+        if hasattr(chunks, "close"):
+            chunks.close()
+
+
+def _stream_scan_aggregate_spill_inner(agg, chain, conf, cache, recovery,
+                                       skip_chunks, seed_partials,
+                                       chunks, chunk_rows):
+    import copy
+    import pyarrow as pa
     if skip_chunks:
         if not hasattr(chunks, "skip_chunks") or \
                 chunks.skip_chunks(skip_chunks) < skip_chunks:
@@ -686,11 +716,6 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
     materialize entire scans. The partial tables are [n, total]-shaped
     arrays sharded on dim 0 — only accumulator-table bytes stay resident
     between chunks."""
-    import jax
-    from jax.sharding import PartitionSpec as Psp
-    from ..parallel.mesh import shard_map
-    from ..parallel.mesh import AXIS
-
     if agg.mode != "partial":
         return None
     if any(getattr(a.func, "positional", False) for a in agg.agg_exprs):
@@ -716,9 +741,6 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
 
     from ..io.sources import maybe_prefetch
     from ..observability.spans import current_shard_telemetry
-    from ..parallel import elastic as EL
-    import pyarrow as pa
-    import time as _time
     n = int(mesh.devices.size)
     telem = current_shard_telemetry()
     needs_base = any(a.func.uses_row_base for a in agg.agg_exprs)
@@ -738,6 +760,30 @@ def stream_scan_aggregate_mesh(agg: "P.HashAggregateExec", mesh, conf,
         leaf.source.load_chunks(leaf.required_columns,
                                 leaf.pushed_filters, chunk_rows),
         conf, recovery)
+    try:
+        return _stream_scan_aggregate_mesh_inner(
+            agg, chain, mesh, conf, cache, recovery, chunks,
+            chunk_rows, n, telem, needs_base, every, ck_key,
+            save_key, ck)
+    finally:
+        # join the prefetch worker on every exit (see
+        # stream_scan_aggregate): a mesh fault or a cancellation
+        # unwinding mid-stream must not leak its ingest daemon
+        if hasattr(chunks, "close"):
+            chunks.close()
+
+
+def _stream_scan_aggregate_mesh_inner(agg, chain, mesh, conf, cache,
+                                      recovery, chunks, chunk_rows, n,
+                                      telem, needs_base, every, ck_key,
+                                      save_key, ck):
+    import jax
+    from jax.sharding import PartitionSpec as Psp
+    from ..parallel.mesh import shard_map
+    from ..parallel.mesh import AXIS
+    from ..parallel import elastic as EL
+    import pyarrow as pa
+    import time as _time
     if ck is not None:
         if not hasattr(chunks, "skip_chunks") or \
                 chunks.skip_chunks(ck.cursor) < ck.cursor:

@@ -82,11 +82,13 @@ def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
 #: chunk_retry / stage_reuse / checkpoint_restore are the
 #: partial-progress actions (execution/recovery.py); mesh_restart /
 #: decommission / shard_rebalance are the elastic-mesh actions
-#: (parallel/elastic.py).
+#: (parallel/elastic.py); cancel marks a query stopped by lifecycle
+#: control — cancellation or a blown queryDeadlineMs
+#: (execution/lifecycle.py).
 FAULT_ACTIONS = ("transient_retry", "stage_timeout", "oom_cache_evict",
                  "oom_spill_reroute", "mesh_fallback", "chunk_retry",
                  "stage_reuse", "checkpoint_restore", "mesh_restart",
-                 "decommission", "shard_rebalance")
+                 "decommission", "shard_rebalance", "cancel")
 
 
 def fault_summary(events: pd.DataFrame) -> pd.DataFrame:

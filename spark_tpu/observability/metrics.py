@@ -84,6 +84,16 @@ METRIC_PREFIXES = (
     "compile_cache_",  # compile_cache_hits/_misses/_disk_hits/
                        # _disk_misses/_deser_ms/_write_bytes/
                        # _corrupt/_warm_entries
+    # query lifecycle control (execution/lifecycle.py + service/):
+    # REGISTRY counters, listed for namespace closure — cancelled and
+    # deadline-exceeded query totals (counted once per query: at the
+    # executor when the engine saw the query, at the service when it
+    # was cancelled out of the admission queue before executing) and
+    # per-session quota rejections (admission maxConcurrent bound +
+    # arbiter hbmShare lease denials)
+    "query_cancelled",       # queries stopped by cancel()/DELETE
+    "query_deadline_",       # query_deadline_exceeded: blown budgets
+    "session_quota_",        # session_quota_rejections
 )
 
 

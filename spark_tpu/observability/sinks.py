@@ -180,7 +180,9 @@ class MetricsSinkListener(QueryListener):
     def on_query_end(self, event: QueryEndEvent) -> None:
         m = self._session.metrics
         m.counter("queries_total").inc()
-        if event.status != "ok":
+        if event.status not in ("ok", "cancelled", "deadline_exceeded"):
+            # lifecycle stops are not failures: they carry their own
+            # query_cancelled / query_deadline_exceeded counters
             m.counter("queries_failed").inc()
         ev = event.event or {}
         phases = ev.get("phase_times_s") or {}

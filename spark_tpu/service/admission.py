@@ -9,6 +9,19 @@ and a queued query that waits longer than
 load sheds at the front door instead of growing an unbounded backlog
 (the reference rejects at the pool the same way).
 
+Queue waits are CANCELLABLE (execution/lifecycle.py): with a cancel
+token installed — the service installs one per request — the cv wait
+runs in short slices capped by the remaining queryDeadlineMs budget,
+and a cancelled/deadlined waiter leaves the queue with its slot math
+intact, never having executed.
+
+`SessionQuota` adds the per-session half
+(`spark_tpu.service.session.maxConcurrent`): one session name's
+in-flight submissions are bounded separately, so a single greedy
+session cannot consume every queue slot and starve the pool —
+exceeding it rejects with SESSION_QUOTA_EXCEEDED (HTTP 429) and
+counts `session_quota_rejections`.
+
 Every transition posts a typed `ServiceEvent` on the service bus and
 counts into the shared metrics registry, so `GET /metrics` shows
 admitted/queued/rejected/timeout totals live.
@@ -19,6 +32,8 @@ from __future__ import annotations
 import threading
 import time
 from typing import Dict, Optional
+
+SESSION_MAX_CONCURRENT_KEY = "spark_tpu.service.session.maxConcurrent"
 
 
 class AdmissionError(RuntimeError):
@@ -50,6 +65,60 @@ class AdmissionTimeout(AdmissionError):
     http_status = 503
 
 
+class SessionQuotaExceeded(AdmissionError):
+    """The session's per-session in-flight quota
+    (spark_tpu.service.session.maxConcurrent) is full."""
+
+    code = "SESSION_QUOTA_EXCEEDED"
+    http_status = 429
+
+
+class SessionQuota:
+    """Per-session in-flight submission counter. `acquire` is
+    check-and-increment under the quota lock; rejection bookkeeping
+    (counter + structured raise) runs outside it. 0 = unlimited."""
+
+    def __init__(self, max_per_session: int, metrics=None):
+        self.max_per_session = int(max_per_session)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+
+    def acquire(self, session: str) -> None:
+        """Count one in-flight submission for `session`; raises
+        SessionQuotaExceeded (structured, HTTP 429) at the bound."""
+        if self.max_per_session <= 0:
+            return
+        with self._lock:
+            n = self._inflight.get(session, 0)
+            over = n >= self.max_per_session
+            if not over:
+                self._inflight[session] = n + 1
+        if over:
+            if self.metrics is not None:
+                self.metrics.counter("session_quota_rejections").inc()
+            raise SessionQuotaExceeded(
+                f"session '{session}' at its in-flight quota "
+                f"({n}/{self.max_per_session})",
+                session=session, in_flight=n,
+                session_max_concurrent=self.max_per_session)
+
+    def release(self, session: str) -> None:
+        if self.max_per_session <= 0:
+            return
+        with self._lock:
+            n = self._inflight.get(session, 0) - 1
+            if n <= 0:
+                self._inflight.pop(session, None)
+            else:
+                self._inflight[session] = n
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"max_per_session": self.max_per_session,
+                    "sessions_in_flight": dict(self._inflight)}
+
+
 class AdmissionController:
     """Condition-variable slot gate. `slot(...)` is a context manager:
     entering acquires (or queues for) an execution slot, exiting
@@ -79,7 +148,13 @@ class AdmissionController:
 
     def acquire(self, query_id: str = "") -> None:
         """Take an execution slot, queueing within bounds. Raises
-        AdmissionRejected / AdmissionTimeout (structured)."""
+        AdmissionRejected / AdmissionTimeout (structured), or the
+        structured lifecycle error when the request's cancel token was
+        cancelled / its deadline blew while queued (the waiter leaves
+        the queue without ever executing; slot math intact)."""
+        from ..execution import lifecycle
+        # cooperative boundary before taking (or queueing for) a slot
+        lifecycle.checkpoint("admission")
         deadline = None
         if self.queue_timeout_ms > 0:
             deadline = time.monotonic() + self.queue_timeout_ms / 1e3
@@ -125,7 +200,14 @@ class AdmissionController:
                             f"queued={self.queued})",
                             running=self.running, queued=self.queued,
                             queue_timeout_ms=self.queue_timeout_ms)
-                    self._cv.wait(remaining)
+                    # token-capped wait: with a cancel token installed
+                    # the wait runs in short slices (bounded by the
+                    # remaining deadline budget) and re-checks the
+                    # token each wakeup, so DELETE /queries/<id> or a
+                    # blown queryDeadlineMs lands within ~one slice
+                    # instead of after queueTimeoutMs
+                    self._cv.wait(lifecycle.wait_slice(remaining))
+                    lifecycle.checkpoint("queue_wait")
             finally:
                 self.queued -= 1
                 self._gauges()

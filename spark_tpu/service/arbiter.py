@@ -40,16 +40,21 @@ from typing import Dict, Optional
 DEVICE_BUDGET_KEY = "spark_tpu.sql.memory.deviceBudget"
 HBM_BUDGET_KEY = "spark_tpu.service.hbmBudget"
 RESULT_CACHE_BYTES_KEY = "spark_tpu.service.resultCacheBytes"
+SESSION_HBM_SHARE_KEY = "spark_tpu.service.session.hbmShare"
 
 
 class _Owner:
     """Identity of one query execution's leases (created per
-    execute_batch / external collect via `enter_query`)."""
+    execute_batch / external collect via `enter_query`). `group` is
+    the session identity (the app_id prefix of the executor's
+    "app:qN" label) — the unit the per-session hbmShare quota
+    aggregates leases over."""
 
-    __slots__ = ("label",)
+    __slots__ = ("label", "group")
 
     def __init__(self, label: str = ""):
         self.label = label
+        self.group = label.rsplit(":q", 1)[0] if ":q" in label else label
 
 
 #: the owner of the query execution running in the current context;
@@ -113,12 +118,25 @@ class DeviceResourceArbiter:
     # -- leasing ------------------------------------------------------------
 
     def try_acquire(self, owner: Optional[_Owner], key, nbytes: int,
-                    wait_ms: float = 0.0) -> bool:
+                    wait_ms: float = 0.0, share: float = 0.0) -> bool:
         """Lease `nbytes` of residency for (owner, key). Storage (the
         device table cache) is evicted LRU-first under pressure — the
         UnifiedMemoryManager storage-eviction move — then the request
         waits up to `wait_ms` for other queries to release, then is
-        denied (the caller takes the out-of-core path)."""
+        denied (the caller takes the out-of-core path).
+
+        `share` (spark_tpu.service.session.hbmShare) caps ONE owner
+        group's (= session's) total leases at share * pool: a lease
+        that would push the session past its share is denied
+        immediately (`session_quota_rejections`) — waiting could only
+        succeed by the session releasing its own leases, which happens
+        at query end, after this query already committed to a path.
+
+        Lease waits are cancellable: with a lifecycle token installed
+        the cv wait runs in deadline-capped slices and a
+        cancelled/deadlined waiter raises the structured error out of
+        the gate (the query is stopping — there is no path to route)."""
+        from ..execution import lifecycle
         from ..io.device_cache import CACHE
         if owner is None:
             # no query scope (direct engine use with an arbiter
@@ -126,6 +144,7 @@ class DeviceResourceArbiter:
             # there is no release point to hold a lease open for
             return nbytes <= self.headroom()
         deadline = time.monotonic() + wait_ms / 1e3
+        group_cap = int(share * self.total) if share > 0 else 0
         with self._cv:
             held = self._leases.get(owner, {})
             if key in held:
@@ -133,6 +152,16 @@ class DeviceResourceArbiter:
             if key in self._denied.get(owner, ()):
                 return False
             while True:
+                if group_cap > 0:
+                    group_leased = sum(
+                        sum(d.values())
+                        for o, d in self._leases.items()
+                        if o.group == owner.group)
+                    if group_leased + nbytes > group_cap:
+                        self._denied.setdefault(owner, set()).add(key)
+                        self._count("arbiter_lease_denied")
+                        self._count("session_quota_rejections")
+                        return False
                 free = (self.total - self._leased_locked()
                         - self._storage_bytes())
                 if nbytes <= free:
@@ -151,7 +180,8 @@ class DeviceResourceArbiter:
                     self._denied.setdefault(owner, set()).add(key)
                     self._count("arbiter_lease_denied")
                     return False
-                self._cv.wait(remaining)
+                self._cv.wait(lifecycle.wait_slice(remaining))
+                lifecycle.checkpoint("lease_wait")
 
     def pin_storage(self, owner: Optional[_Owner], key) -> None:
         """Record that `owner` is executing against the CACHED copy of
@@ -336,7 +366,10 @@ def admit_scan_resident(conf, leaf) -> bool:
     if est is None:
         return False  # unsizeable lease: stream it
     key = scan_cache_key(leaf) or ("scan", id(leaf))
-    return arb.try_acquire(_OWNER.get(), key, est)
+    # per-session share quota: one session's leases are capped at
+    # hbmShare * pool — over-share scans stream instead of pinning HBM
+    share = float(conf.get(SESSION_HBM_SHARE_KEY))
+    return arb.try_acquire(_OWNER.get(), key, est, share=share)
 
 
 def note_scan_cached(key) -> None:

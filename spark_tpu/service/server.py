@@ -23,9 +23,29 @@ Endpoints:
   flops/bytes/peak-HBM, per-shard flight-recorder records.
 - ``GET /queries/<id>/plan``: the submitted SQL plus the describe()
   fingerprint and the runtime-annotated physical tree.
+- ``DELETE /queries/<id>``: cancel a submitted/running query
+  (execution/lifecycle.py). A running query stops at its next
+  cooperative boundary (chunk, stage attempt, backoff, queue/lease
+  wait) with a structured ``QUERY_CANCELLED`` error; a queued async
+  request leaves the admission queue without ever executing. 200 with
+  ``cancel_requested``; 404 (structured) for an unknown id; 409 for a
+  query that already finished. Idempotent: a second DELETE of a
+  still-stopping query is another 200.
 - ``GET /metrics``: the shared metrics registry in Prometheus text
   exposition (queries, admission, arbiter, compile/result caches).
-- ``GET /healthz``: liveness + pool/admission/arbiter stats.
+- ``GET /healthz``: liveness + pool/admission/arbiter/quota stats.
+
+Per-request deadline: ``POST /sql`` honors
+``spark_tpu.execution.queryDeadlineMs`` from the request's ``conf``
+map (or the service conf), armed at SUBMIT entry so admission-queue
+and session waits count against the end-to-end budget; a blown
+deadline surfaces as a structured ``QUERY_DEADLINE_EXCEEDED`` error.
+
+Per-session quotas: ``spark_tpu.service.session.maxConcurrent`` bounds
+one session name's in-flight submissions (SESSION_QUOTA_EXCEEDED, 429)
+and ``spark_tpu.service.session.hbmShare`` caps one session's arbiter
+leases — a greedy session degrades to out-of-core paths instead of
+starving the pool.
 """
 
 from __future__ import annotations
@@ -38,13 +58,15 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ..config import Conf
+from ..execution import lifecycle
 from ..expr import AnalysisError
 from ..observability import ListenerBus, MetricsRegistry, QueryListener
 from ..observability.listener import ServiceEvent
 from ..observability.sinks import json_default
 from ..sql.lexer import ParseError
-from .admission import (AdmissionController, AdmissionError,
-                        AdmissionRejected, AdmissionTimeout)
+from .admission import (SESSION_MAX_CONCURRENT_KEY, AdmissionController,
+                        AdmissionError, AdmissionRejected,
+                        AdmissionTimeout, SessionQuota)
 from .arbiter import (DeviceResourceArbiter, get_arbiter, install_arbiter)
 from .pool import PoolExhausted, SessionPool
 from .query_history import (HISTORY_SIZE_KEY, QueryHistoryStore,
@@ -136,8 +158,17 @@ class SqlService:
             int(self.conf.get(QUEUE_DEPTH_KEY)),
             float(self.conf.get(QUEUE_TIMEOUT_KEY)),
             metrics=self.metrics, on_event=self._post)
+        #: per-session in-flight quota (session.maxConcurrent): one
+        #: greedy session cannot consume every admission slot
+        self.session_quota = SessionQuota(
+            int(self.conf.get(SESSION_MAX_CONCURRENT_KEY)),
+            metrics=self.metrics)
         self._records: "OrderedDict[str, Dict]" = OrderedDict()
         self._records_lock = threading.Lock()
+        #: cancel tokens of submitted/running queries, by service query
+        #: id (DELETE /queries/<id> reaches them cross-thread); entries
+        #: are dropped when their query finishes
+        self._tokens: Dict[str, "lifecycle.CancelToken"] = {}
         #: in-flight async submissions (each is a worker thread):
         #: bounded at maxConcurrent + queueDepth so an async burst
         #: sheds at the front door like sync traffic does, instead of
@@ -175,13 +206,28 @@ class SqlService:
 
     # -- query registry -----------------------------------------------------
 
-    def _new_record(self, sql: str, session: str) -> Dict:
+    def _new_record(self, sql: str, session: str,
+                    conf: Optional[Dict] = None) -> Dict:
+        """Create the status record AND its cancel token in ONE
+        critical section: the moment a record is visible to
+        DELETE /queries/<id>, its token is reachable too — no window
+        where a submitted query reads as 'already finished'. The
+        deadline arms HERE (submit entry, per-request conf override
+        falling back to the service conf): queryDeadlineMs is
+        end-to-end, so admission-queue and busy-session waits count
+        against it."""
+        v = (conf or {}).get(lifecycle.DEADLINE_KEY)
+        if v is None:
+            v = self.conf.get(lifecycle.DEADLINE_KEY)
+        ms = float(v or 0)
+        tok = lifecycle.CancelToken(deadline_ms=ms if ms > 0 else None)
         with self._records_lock:
             self._seq += 1
             rid = f"q-{self._seq}"
             record = {"id": rid, "sql": sql[:500], "session": session,
                       "status": "submitted", "submitted_ts": time.time()}
             self._records[rid] = record
+            self._tokens[rid] = tok
             # bound the registry by evicting oldest FINISHED records
             # only: a running/async record is a client's only handle to
             # its query — dropping it would 404 the status poll and
@@ -232,11 +278,21 @@ class SqlService:
         """Lease the named session (its execution is serialized),
         bounded by the queueTimeoutMs discipline so a request stuck
         behind a long-running query sheds with a structured 503
-        instead of waiting forever."""
+        instead of waiting forever. Cancellable: the wait runs in
+        token-capped slices (execution/lifecycle.py), so a DELETE or
+        a blown queryDeadlineMs releases the waiter promptly."""
         timeout_ms = self.admission.queue_timeout_ms
-        if entry.lock.acquire(
-                timeout=timeout_ms / 1e3 if timeout_ms > 0 else -1):
-            return
+        deadline = (time.monotonic() + timeout_ms / 1e3
+                    if timeout_ms > 0 else None)
+        while True:
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            s = lifecycle.wait_slice(remaining)
+            if entry.lock.acquire(timeout=s if s is not None else -1):
+                return
+            lifecycle.checkpoint("session_wait")
         self.metrics.counter("service_queue_timeout").inc()
         self._post("queue_timeout", query_id,
                    detail=f"session={session} busy", session=session)
@@ -244,51 +300,107 @@ class SqlService:
             f"session '{session}' still busy after {timeout_ms:g}ms",
             session=session, queue_timeout_ms=timeout_ms)
 
+    def _get_token(self, rid: str) -> Optional["lifecycle.CancelToken"]:
+        with self._records_lock:
+            return self._tokens.get(rid)
+
+    def _drop_token(self, rid: str) -> None:
+        with self._records_lock:
+            self._tokens.pop(rid, None)
+
+    def _finish_lifecycle(self, record: Dict, e: Exception,
+                          session: str) -> None:
+        """Record a cancelled/deadlined outcome: structured error body,
+        terminal status, lifecycle counter (only when the query never
+        reached the engine — executions that started already counted
+        in the executor), and the service event."""
+        cancelled = isinstance(e, lifecycle.QueryCancelledError)
+        status = "cancelled" if cancelled else "deadline_exceeded"
+        record["status"] = status
+        record["error"] = {
+            "error": ("QUERY_CANCELLED" if cancelled
+                      else "QUERY_DEADLINE_EXCEEDED"),
+            "message": f"{type(e).__name__}: {e}"[:400],
+            "query_id": record["id"]}
+        record["finished_ts"] = time.time()
+        if "started_ts" not in record:
+            self.metrics.counter(
+                "query_cancelled" if cancelled
+                else "query_deadline_exceeded").inc()
+        self._post(status, record["id"], session=session)
+
     def submit(self, sql: str, session: str = "default",
                conf: Optional[Dict] = None):
         """Run `sql` on the named pooled session under admission
         control. Returns (record, Arrow table). Raises AdmissionError /
-        PoolExhausted (structured) or whatever the engine raised; the
-        record reflects the outcome either way."""
-        record = self._new_record(sql, session)
+        PoolExhausted / the structured lifecycle errors, or whatever
+        the engine raised; the record reflects the outcome either
+        way."""
+        record = self._new_record(sql, session, conf)
         rid = record["id"]
         self._ensure_arbiter()
         self.metrics.counter("service_queries_submitted").inc()
         self._post("submitted", rid, session=session)
+        ctx_token = lifecycle.install(self._get_token(rid))
         try:
-            # session serialization FIRST, admission slot second: a
-            # request blocked behind a busy session must not hold one
-            # of the maxConcurrent execution slots while doing no work
-            # (it would starve other sessions' requests into 429/503)
-            entry = self.pool.get_or_create(session)
-            self._lock_session(entry, session, rid)
+            # per-session quota FIRST: a greedy session sheds at its
+            # own bound before consuming a pool-wide queue slot
+            self.session_quota.acquire(session)
             try:
-                # overrides land inside the same lock window the query
-                # executes in: sticky per-session SET semantics, and a
-                # concurrent request can neither clobber them before
-                # this query runs nor land its own mid-query
-                if conf:
-                    for k, v in conf.items():
-                        entry.session.conf.set(k, v)
-                with self.admission.slot(rid):
-                    entry.current_record = record
-                    record["status"] = "running"
-                    record["started_ts"] = time.time()
-                    try:
-                        with entry.session.as_active():
-                            qe = entry.session.sql(sql)._qe()
-                            table = qe.collect()
-                    finally:
-                        entry.current_record = None
+                # session serialization next, admission slot second: a
+                # request blocked behind a busy session must not hold
+                # one of the maxConcurrent execution slots while doing
+                # no work (it would starve other sessions' requests
+                # into 429/503)
+                entry = self.pool.get_or_create(session)
+                self._lock_session(entry, session, rid)
+                try:
+                    # overrides land inside the same lock window the
+                    # query executes in: sticky per-session SET
+                    # semantics, and a concurrent request can neither
+                    # clobber them before this query runs nor land its
+                    # own mid-query
+                    if conf:
+                        for k, v in conf.items():
+                            entry.session.conf.set(k, v)
+                    with self.admission.slot(rid):
+                        entry.current_record = record
+                        record["status"] = "running"
+                        record["started_ts"] = time.time()
+                        try:
+                            with entry.session.as_active():
+                                qe = entry.session.sql(sql)._qe()
+                                table = qe.collect()
+                        finally:
+                            entry.current_record = None
+                finally:
+                    entry.lock.release()
             finally:
-                entry.lock.release()
+                self.session_quota.release(session)
+            # success bookkeeping INSIDE the try: the record must read
+            # terminal before the finally drops the token, so a racing
+            # DELETE never sees (running, no token) mid-transition
+            record["status"] = "ok"
+            record["row_count"] = int(table.num_rows)
+            record["finished_ts"] = time.time()
+            record["elapsed_ms"] = round(
+                (record["finished_ts"] - record["started_ts"]) * 1e3, 1)
+            self.metrics.counter("service_completed").inc()
+            self._post("finished", rid, session=session)
         except AdmissionError as e:
-            record["status"] = ("rejected"
-                                if e.code == "ADMISSION_REJECTED"
-                                else "queue_timeout")
+            record["status"] = ("queue_timeout"
+                                if e.code == "ADMISSION_TIMEOUT"
+                                else "rejected")
             e.detail.setdefault("query_id", rid)
             record["error"] = e.to_dict()
             record["finished_ts"] = time.time()
+            if e.code == "SESSION_QUOTA_EXCEEDED":
+                # the AdmissionController counts its own rejections;
+                # quota rejections get the same service-level
+                # bookkeeping here (submit_async's quota catch does)
+                self.metrics.counter("service_rejected").inc()
+                self._post("rejected", rid, detail="sessionQuota",
+                           session=session)
             raise
         except PoolExhausted as e:
             # capacity rejection, not an engine failure: must not count
@@ -300,6 +412,10 @@ class SqlService:
             self.metrics.counter("service_rejected").inc()
             self._post("rejected", rid, detail="maxSessions",
                        session=session)
+            raise
+        except (lifecycle.QueryCancelledError,
+                lifecycle.QueryDeadlineError) as e:
+            self._finish_lifecycle(record, e, session)
             raise
         except Exception as e:  # noqa: BLE001 — recorded, then surfaced
             record["status"] = "error"
@@ -313,13 +429,9 @@ class SqlService:
             self._post("failed", rid, detail=type(e).__name__,
                        session=session)
             raise
-        record["status"] = "ok"
-        record["row_count"] = int(table.num_rows)
-        record["finished_ts"] = time.time()
-        record["elapsed_ms"] = round(
-            (record["finished_ts"] - record["started_ts"]) * 1e3, 1)
-        self.metrics.counter("service_completed").inc()
-        self._post("finished", rid, session=session)
+        finally:
+            lifecycle.uninstall(ctx_token)
+            self._drop_token(rid)
         return record, table
 
     def submit_async(self, sql: str, session: str = "default",
@@ -329,8 +441,25 @@ class SqlService:
         holds no result — async is for effects/status, sync for data.
         Raises AdmissionRejected (structured, HTTP 429) when
         maxConcurrent + queueDepth async submissions are already in
-        flight."""
-        record = self._new_record(sql, session)
+        flight, or SessionQuotaExceeded at the per-session bound.
+
+        The cancel token is created WITH the record, before the worker
+        spawns: a DELETE arriving while the request is still queued
+        cancels it out of the admission queue without it ever
+        executing."""
+        record = self._new_record(sql, session, conf)
+        try:
+            self.session_quota.acquire(session)
+        except AdmissionError as err:
+            record["status"] = "rejected"
+            err.detail.setdefault("query_id", record["id"])
+            record["error"] = err.to_dict()
+            record["finished_ts"] = time.time()
+            self._drop_token(record["id"])
+            self.metrics.counter("service_rejected").inc()
+            self._post("rejected", record["id"],
+                       detail="sessionQuota", session=session)
+            raise
         bound = (self.admission.max_concurrent
                  + self.admission.queue_depth)
         # the bound check-and-increment is the only atomic part; the
@@ -343,6 +472,7 @@ class SqlService:
             if not rejected:
                 self._async_inflight += 1
         if rejected:
+            self.session_quota.release(session)
             err = AdmissionRejected(
                 f"async submissions in flight at bound "
                 f"({in_flight}/{bound})",
@@ -351,14 +481,21 @@ class SqlService:
             record["status"] = "rejected"
             record["error"] = err.to_dict()
             record["finished_ts"] = time.time()
+            self._drop_token(record["id"])
             self.metrics.counter("service_rejected").inc()
             self._post("rejected", record["id"],
                        detail="asyncInFlight", session=session)
             raise err
 
+        tok = self._get_token(record["id"])
+
         def run():
             # re-drive through submit's machinery minus re-registration
-            # (same ordering as submit: session lease, then slot)
+            # (same ordering as submit: session lease, then slot). The
+            # token installs on THIS worker thread: a cancel delivered
+            # while queued raises out of the admission/session waits
+            # and the request never executes (slot math intact).
+            ctx_token = lifecycle.install(tok)
             try:
                 entry = self.pool.get_or_create(session)
                 self._lock_session(entry, session, record["id"])
@@ -384,9 +521,9 @@ class SqlService:
                 finally:
                     entry.lock.release()
             except AdmissionError as e:
-                record["status"] = ("rejected"
-                                    if e.code == "ADMISSION_REJECTED"
-                                    else "queue_timeout")
+                record["status"] = ("queue_timeout"
+                                    if e.code == "ADMISSION_TIMEOUT"
+                                    else "rejected")
                 record["error"] = e.to_dict()
             except PoolExhausted as e:
                 record["status"] = "rejected"
@@ -394,6 +531,9 @@ class SqlService:
                 self.metrics.counter("service_rejected").inc()
                 self._post("rejected", record["id"],
                            detail="maxSessions", session=session)
+            except (lifecycle.QueryCancelledError,
+                    lifecycle.QueryDeadlineError) as e:
+                self._finish_lifecycle(record, e, session)
             except Exception as e:  # noqa: BLE001 — poll-visible
                 record["status"] = "error"
                 code = ("INVALID_SQL"
@@ -405,15 +545,36 @@ class SqlService:
                 self.metrics.counter("service_failed").inc()
                 self._post("failed", record["id"], session=session)
             finally:
+                lifecycle.uninstall(ctx_token)
+                self._drop_token(record["id"])
+                self.session_quota.release(session)
                 with self._async_lock:
                     self._async_inflight -= 1
             record["finished_ts"] = time.time()
 
-        self._ensure_arbiter()
-        self.metrics.counter("service_queries_submitted").inc()
-        self._post("submitted", record["id"], session=session)
-        threading.Thread(target=run, daemon=True,
-                         name=f"sql-{record['id']}").start()
+        try:
+            self._ensure_arbiter()
+            self.metrics.counter("service_queries_submitted").inc()
+            self._post("submitted", record["id"], session=session)
+            threading.Thread(target=run, daemon=True,
+                             name=f"sql-{record['id']}").start()
+        except BaseException as e:
+            # Thread.start() can fail under thread exhaustion — the
+            # exact overload quotas exist for. run()'s finally (the
+            # only release path) never executes, so undo its
+            # bookkeeping here or the session permanently loses a
+            # quota slot (and the record reads 'submitted' forever,
+            # unevictable)
+            self.session_quota.release(session)
+            with self._async_lock:
+                self._async_inflight -= 1
+            self._drop_token(record["id"])
+            record["status"] = "error"
+            record["error"] = {"error": "EXECUTION_ERROR",
+                               "message": f"{type(e).__name__}: "
+                                          f"{e}"[:400]}
+            record["finished_ts"] = time.time()
+            raise
         return record
 
     # -- endpoints' data ----------------------------------------------------
@@ -496,6 +657,31 @@ class SqlService:
                 "analysis_findings": detail.get("analysis_findings")
                 or []}
 
+    def cancel_query(self, query_id: str):
+        """Request cooperative cancellation of a submitted/running
+        query (the DELETE /queries/<id> seat). Returns (http_status,
+        json_body) — 200 cancel_requested, 404 unknown id (structured,
+        same error shape as 429/503), 409 already finished.
+        Idempotent: a second DELETE of a still-stopping query returns
+        another 200; cancel-after-finish is the 409."""
+        rec = self.get_query(query_id)
+        if rec is None:
+            return 404, {"error": "NOT_FOUND",
+                         "message": f"unknown query id {query_id!r}",
+                         "query_id": query_id}
+        with self._records_lock:
+            tok = self._tokens.get(query_id)
+        status = rec.get("status")
+        if tok is None or status not in ("submitted", "running"):
+            return 409, {"error": "QUERY_FINISHED",
+                         "message": f"query {query_id} already "
+                                    f"finished (status={status})",
+                         "query_id": query_id, "status": status}
+        tok.cancel()
+        self._post("cancel_requested", query_id,
+                   session=rec.get("session", ""))
+        return 200, {"query_id": query_id, "status": "cancel_requested"}
+
     def metrics_text(self) -> str:
         from ..observability.metrics import prometheus_text
         return prometheus_text(self.metrics.snapshot())
@@ -505,6 +691,7 @@ class SqlService:
                 "uptime_s": round(time.time() - self._started_ts, 1),
                 "sessions": len(self.pool),
                 "admission": self.admission.stats(),
+                "session_quota": self.session_quota.stats(),
                 "arbiter": self.arbiter.stats()
                 if self._installed_arbiter else None}
 
@@ -641,18 +828,34 @@ def _make_handler(service: SqlService):
                 self._send_json(200, listing)
             elif path.startswith("/queries/"):
                 rest = path[len("/queries/"):]
+                qid = rest
                 if rest.endswith("/timeline"):
-                    payload = service.query_timeline(
-                        rest[:-len("/timeline")])
+                    qid = rest[:-len("/timeline")]
+                    payload = service.query_timeline(qid)
                 elif rest.endswith("/plan"):
-                    payload = service.query_plan(rest[:-len("/plan")])
+                    qid = rest[:-len("/plan")]
+                    payload = service.query_plan(qid)
                 else:
                     payload = service.query_snapshot(rest)
                 if payload is None:
-                    self._send_json(404, {"error": "NOT_FOUND",
-                                          "message": path})
+                    # structured 404: same error shape as the 429/503
+                    # admission bodies (error + message + detail)
+                    self._send_json(404, {
+                        "error": "NOT_FOUND",
+                        "message": f"unknown query id {qid!r}",
+                        "query_id": qid})
                 else:
                     self._send_json(200, payload)
+            else:
+                self._send_json(404, {"error": "NOT_FOUND",
+                                      "message": path})
+
+        def do_DELETE(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            if path.startswith("/queries/"):
+                qid = path[len("/queries/"):]
+                status, payload = service.cancel_query(qid)
+                self._send_json(status, payload)
             else:
                 self._send_json(404, {"error": "NOT_FOUND",
                                       "message": path})
@@ -705,6 +908,19 @@ def _make_handler(service: SqlService):
             except (ParseError, AnalysisError) as e:
                 self._send_json(400, {
                     "error": "INVALID_SQL",
+                    "message": f"{type(e).__name__}: {e}"[:400]})
+                return
+            except lifecycle.QueryCancelledError as e:
+                # the sync request's query was DELETEd mid-flight:
+                # structured body, 409 (the request conflicts with an
+                # explicit cancel of its own resource)
+                self._send_json(409, {
+                    "error": "QUERY_CANCELLED",
+                    "message": f"{type(e).__name__}: {e}"[:400]})
+                return
+            except lifecycle.QueryDeadlineError as e:
+                self._send_json(504, {
+                    "error": "QUERY_DEADLINE_EXCEEDED",
                     "message": f"{type(e).__name__}: {e}"[:400]})
                 return
             except Exception as e:  # noqa: BLE001 — structured surface

@@ -137,6 +137,18 @@ class SparkTpuSession(metaclass=_ActiveSessionMeta):
         from .execution.compile_cache import warm_start
         return warm_start(self._stage_cache, self.conf, self.metrics)
 
+    def cancel(self, query_id: int) -> bool:
+        """Request cooperative cancellation of a query currently
+        executing on this session (the SparkContext.cancelJobGroup
+        seat, execution/lifecycle.py): the running execution raises a
+        structured QueryCancelledError at its next boundary — chunk,
+        stage attempt, retry backoff, queue/lease wait — releasing
+        every lease/worker/checkpoint it holds. Returns False when no
+        execution with that query_id is registered (already finished,
+        or never started). Callable from any thread."""
+        from .execution import lifecycle
+        return lifecycle.cancel(self.app_id, query_id)
+
     def decommission_shards(self, shards) -> None:
         """Gracefully drain the given mesh positions (elastic mesh,
         parallel/elastic.py): a running mesh stream checkpoints at its

@@ -871,9 +871,16 @@ class StreamingQuery:
         -> offset write -> run (state commit) -> sink emit -> commit
         log -> adopt state -> prune; the stream_* chaos seams fire
         before each persistent action."""
+        from .execution import lifecycle
         from .testing import faults
         faults.arm(self.session.conf)
         while True:
+            # cooperative cancellation boundary once per trigger: a
+            # cancel/deadline between micro-batches stops the loop
+            # with the durable state at the last COMMITTED batch, so
+            # a fresh query over the same checkpoint resumes
+            # exactly-once (execution/lifecycle.py)
+            lifecycle.checkpoint("stream_trigger")
             self._pending = None
             batch_id = self._committed_batch + 1
             # chaos seam: a crash before the loop even polls the source

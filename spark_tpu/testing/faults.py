@@ -18,9 +18,10 @@ Grammar (comma-separated rules):
              | ingest_prefetch | shard_chunk | mesh_restart
              | decommission | stream_source_list
              | stream_offset_write | stream_state_commit
-             | stream_sink_emit | compile_cache_load
+             | stream_sink_emit | compile_cache_load | cancel_point
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
+             | cancel
     nth   := 1-based hit count of `site` at which the rule fires
     arg   := fault argument (only `slow`: sleep milliseconds, default 100)
 
@@ -76,12 +77,28 @@ entry consulted: an armed rule models a corrupted/truncated entry (or
 a backend deserialize rejection), and the contract under ANY failure
 there is log + count (`compile_cache_corrupt`) + fresh compile +
 overwrite — a damaged cache never fails a query.
+
+`cancel_point` fires at EVERY cooperative cancellation boundary
+(execution/lifecycle.py `checkpoint`): stage-attempt entry, compile
+entry, scan ingest, every chunk of every chunk driver, retry-backoff
+entry, admission-queue and arbiter-lease wait wakeups, and the
+streaming trigger loop. Paired with the `cancel` fault class — which
+CANCELS the context's installed token instead of raising, so the very
+checkpoint that fired the rule then raises the structured
+QueryCancelledError — a `cancel_point:cancel:n` rule delivers a
+cancellation at exactly the nth boundary a query crosses: the
+cancel-point chaos matrix (tests/test_lifecycle.py) sweeps `n` across
+execution shapes to prove every boundary releases its resources.
+
+The `slow` fault sleeps on the INTERRUPTIBLE lifecycle wait, not a
+bare time.sleep: a cancel/deadline delivered mid-sleep wakes it
+immediately (raising the structured lifecycle error), so cancel-matrix
+cells that combine slow faults with cancellation terminate promptly.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from contextvars import ContextVar
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
@@ -98,7 +115,8 @@ KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "ingest_prefetch", "shard_chunk", "mesh_restart",
                "decommission", "stream_source_list",
                "stream_offset_write", "stream_state_commit",
-               "stream_sink_emit", "compile_cache_load")
+               "stream_sink_emit", "compile_cache_load",
+               "cancel_point")
 
 #: sites that fire INSIDE a stage trace (once per (re)compile of the
 #: enclosing stage). The persistent compile cache consults this: a
@@ -159,7 +177,7 @@ _MESSAGES = {
         "INTERNAL: injected: unrecoverable failure at {site} (hit {n})",
 }
 
-FAULT_CLASSES = tuple(_MESSAGES) + ("slow",)
+FAULT_CLASSES = tuple(_MESSAGES) + ("slow", "cancel")
 
 
 class FaultInjected(Exception):
@@ -244,7 +262,20 @@ class FaultPlan:
         # serialize unrelated sites' counting
         for r in due:
             if r.fault == "slow":
-                time.sleep((r.arg if r.arg is not None else 100.0) / 1e3)
+                # interruptible: a cancel/deadline delivered mid-sleep
+                # wakes immediately and raises the structured
+                # lifecycle error instead of blocking cancellation
+                # for the full injected latency
+                from ..execution import lifecycle
+                lifecycle.sleep(
+                    (r.arg if r.arg is not None else 100.0) / 1e3)
+                continue
+            if r.fault == "cancel":
+                # cancel the context's installed token: the boundary
+                # that fired this rule (lifecycle.checkpoint) raises
+                # the structured QueryCancelledError right after
+                from ..execution import lifecycle
+                lifecycle.cancel_current()
                 continue
             raise FaultInjected(
                 site, r.fault, _MESSAGES[r.fault].format(site=site, n=n))

@@ -190,8 +190,11 @@ class LockWatch:
         """Wrap a SqlService's locks + the process device cache + every
         pooled session present at call time (warm the pool first, or
         call again after new sessions appear)."""
+        from ..execution import lifecycle
         from ..io.device_cache import CACHE
         self.watch_attr(svc.admission, "_cv", "service.admission")
+        self.watch_attr(svc.session_quota, "_lock", "service.quota")
+        self.watch_attr(lifecycle, "_TOKENS_LOCK", "execution.lifecycle")
         self.watch_attr(svc.arbiter, "_cv", "service.arbiter")
         self.watch_attr(svc.arbiter.result_cache, "_lock",
                         "service.result_cache")

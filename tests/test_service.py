@@ -626,6 +626,12 @@ def test_async_submissions_bounded(service):
     front door (429-shaped) instead of spawning unbounded threads."""
     svc = service(**{MAXC_KEY: 1, DEPTH_KEY: 0, QT_KEY: 100})
     svc.admission.acquire("holder")  # pin the only slot
+    # park the first worker at the session lease too: with warm caches
+    # session init is fast enough that the worker could reach the
+    # queue_depth=0 admission rejection (freeing its in-flight slot)
+    # before the second submission's bound check runs
+    entry = svc.pool.get_or_create("default")
+    entry.lock.acquire()
     try:
         first = svc.submit_async(
             "select count(*) as n from lineitem")  # occupies the bound
@@ -635,7 +641,11 @@ def test_async_submissions_bounded(service):
         assert body["error"] == "ADMISSION_REJECTED"
         assert body["bound"] == 1
     finally:
+        # slot first, lease second: the worker waking from the lease
+        # must find the slot free (queue_depth=0 would otherwise
+        # reject it in the gap between the two releases)
         svc.admission.release()
+        entry.lock.release()
     for _ in range(200):
         if first["status"] in ("ok", "error", "queue_timeout"):
             break
