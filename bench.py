@@ -592,7 +592,70 @@ def _bench_tpch_queries(spark, sf, queries, float_atol, deadline, path,
         G.compare(got.reset_index(drop=True), want,
                   float_rtol=1e-6, float_atol=float_atol)
         extra[f"tpch_{name}_parity"] = True
+    _tpch_udf_sidecars(spark, sf, deadline, extra)
     return extra
+
+
+def _tpch_udf_sidecars(spark, sf, deadline, extra) -> None:
+    """Python-UDF lane sidecars over real TPC-H data: a revenue UDF
+    over the (pruned) lineitem scan in both lanes, so the BENCH
+    trajectory prices the worker pool's IPC overhead against the
+    in-process lane at scale — plus the worker lane's batch count and
+    its prediction grading (udf_batches/udf_rows hit/over/under)."""
+    if deadline is not None and time.perf_counter() > deadline:
+        extra[f"tpch_udf_sf{sf:g}_skipped"] = "time budget"
+        return
+    from spark_tpu.functions import col, pandas_udf, to_date
+    from spark_tpu.history import grade_predictions
+
+    @pandas_udf(returnType="double")
+    def disc_price(ep, d):
+        # the decimal columns arrive as object-dtype Decimal series
+        return ep.astype("float64") * (1.0 - d.astype("float64"))
+
+    mode_key = "spark_tpu.sql.udf.mode"
+    batch_key = "spark_tpu.sql.udf.arrow.maxRecordsPerBatch"
+
+    def run(mode):
+        spark.conf.set(mode_key, mode)
+        qe = (spark.table("lineitem")
+              .filter(col("l_shipdate") <= to_date("1998-09-02"))
+              .select(disc_price(col("l_extendedprice"),
+                                 col("l_discount")).alias("p")))._qe()
+        t0 = time.perf_counter()
+        b, _, _ = qe.execute_batch()
+        dt = time.perf_counter() - t0
+        return qe, b.to_arrow().to_pandas(), dt
+
+    old_batch = spark.conf.get(batch_key)
+    try:
+        qe_in, got_in, t_in = run("inprocess")
+        rows = len(got_in)
+        extra[f"tpch_udf_sf{sf:g}_inprocess_ms"] = round(t_in * 1e3, 1)
+        qe_w, got_w, t_w = run("worker")
+        extra[f"tpch_udf_sf{sf:g}_worker_ms"] = round(t_w * 1e3, 1)
+        if rows:
+            extra[f"tpch_udf_sf{sf:g}_rows_per_sec_M"] = round(
+                rows / t_w / 1e6, 2)
+        assert got_w.equals(got_in), "udf worker-lane parity broke"
+        u = qe_w.udf_summary or {}
+        extra[f"tpch_udf_sf{sf:g}_worker_batches"] = int(
+            u.get("batches", 0))
+        extra[f"tpch_udf_sf{sf:g}_worker_restarts"] = int(
+            u.get("worker_restarts", 0))
+        # grade the analyzer's batch/row prediction against this run
+        graded = grade_predictions(
+            qe_w.plan_predictions or [],
+            {"udf_batches": u.get("batches"), "udf_rows": u.get("rows")})
+        errs = [abs(g["err_pct"]) for g in graded
+                if g["kind"].startswith("udf")
+                and g.get("err_pct") is not None]
+        if errs:
+            extra[f"tpch_udf_sf{sf:g}_pred_err_pct"] = round(
+                sum(errs) / len(errs), 1)
+    finally:
+        spark.conf.set(mode_key, "inprocess")
+        spark.conf.set(batch_key, old_batch)
 
 
 def bench_tpcds(spark, sf: float, path: str,
@@ -803,6 +866,71 @@ def bench_obs_overhead(spark):
         spark, lambda: Q.QUERIES["q1"](spark)._qe().collect(), base)
 
 
+def bench_udf(spark):
+    """Python-UDF lane section: rows/s for one vectorized pandas_udf
+    over a synthetic frame, in-process vs the Arrow-batched worker
+    pool, the worker lane at TWO `udf.arrow.maxRecordsPerBatch` sizes
+    (the batch size is the lane's one tuning knob: small batches bound
+    replay cost, large batches amortize the IPC round-trip). Sidecars:
+    `udf_inprocess_rows_per_sec_M`, `udf_worker_rows_per_sec_M_b<N>`
+    per batch size, plus the observed batch/restart counters from the
+    worker runs."""
+    import pandas as pd
+
+    from spark_tpu.functions import col, pandas_udf
+
+    mode_key = "spark_tpu.sql.udf.mode"
+    batch_key = "spark_tpu.sql.udf.arrow.maxRecordsPerBatch"
+    n = 1 << 20
+    batch_sizes = (16384, 131072)
+
+    @pandas_udf(returnType="double")
+    def fused(x, y):
+        return x * 1.0001 + y.fillna(0.0) * 0.5
+
+    df = (spark.range(n)
+          .select(fused(col("id"), col("id")).alias("v")))
+
+    def run_once():
+        qe = df._qe()
+        t0 = time.perf_counter()
+        b, _, _ = qe.execute_batch()
+        dt = time.perf_counter() - t0
+        return qe, b, dt
+
+    def best2():
+        run_once()  # warmup: compile + (worker mode) pool spawn
+        qe = best = None
+        for _ in range(2):
+            qe, _, dt = run_once()
+            best = dt if best is None else min(best, dt)
+        return qe, best
+
+    out = {"udf_rows": n}
+    old_mode = spark.conf.get(mode_key)
+    old_batch = spark.conf.get(batch_key)
+    try:
+        spark.conf.set(mode_key, "inprocess")
+        _, best = best2()
+        out["udf_inprocess_rows_per_sec_M"] = round(n / best / 1e6, 2)
+        spark.conf.set(mode_key, "worker")
+        for bs in batch_sizes:
+            spark.conf.set(batch_key, bs)
+            qe, best = best2()
+            out[f"udf_worker_rows_per_sec_M_b{bs}"] = round(
+                n / best / 1e6, 2)
+            summ = getattr(qe, "udf_summary", None) or {}
+            out[f"udf_worker_batches_b{bs}"] = summ.get("batches")
+            restarts = summ.get("worker_restarts")
+            if restarts:
+                out[f"udf_worker_restarts_b{bs}"] = restarts
+    finally:
+        spark.conf.set(mode_key, old_mode or "inprocess")
+        if old_batch is not None:
+            spark.conf.set(batch_key, old_batch)
+    return out
+
+
 def main():
     from spark_tpu import SparkTpuSession
 
@@ -883,6 +1011,11 @@ def main():
     extra.update(run_budgeted(
         "streaming", lambda: bench_streaming(spark),
         min(budget, 240)))
+    emit_summary()
+    # Python-UDF lane: in-process vs Arrow worker pool rows/s at two
+    # batch sizes (the lane's tuning knob)
+    extra.update(run_budgeted(
+        "udf", lambda: bench_udf(spark), min(budget, 240)))
     emit_summary()
     # persistent compile cache: cold vs warm PROCESS compile cost via
     # two fresh subprocesses sharing one cache dir

@@ -8,9 +8,10 @@ enforceable in CI:
 
     scripts/events_tool.py validate <file-or-dir> [...]
         Validate every app-*.jsonl line against the versioned schema.
-        Knows every published schema_version (1..4): v3 added the
+        Knows every published schema_version (1..5): v3 added the
         per-shard `shards` records, `plan_tree` and `predictions`;
-        v4 added the per-micro-batch `streaming` record — purely
+        v4 added the per-micro-batch `streaming` record; v5 added the
+        per-query `udf` record (worker-lane batch/row totals) — purely
         additive, so old logs must (and do) validate under their own
         version's rules. Exits nonzero listing file:line: problem for
         every violation.
@@ -31,7 +32,7 @@ import json
 import os
 import sys
 
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4)
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
 
 #: per-micro-batch streaming record contract (schema v4):
 #: field -> allowed types
@@ -49,6 +50,20 @@ _STREAMING_FIELDS = {
 }
 
 _STREAMING_KINDS = ("stateless", "delta", "snapshot")
+
+#: per-query Python-UDF record contract (schema v5): field -> allowed
+#: types (one record per execution that evaluated UDFs, summed across
+#: UDF nodes; mirrors the udf_* metric counters)
+_UDF_FIELDS = {
+    "mode": (str,),
+    "batches": (int,),
+    "rows": (int,),
+    "exec_ms": (int, float),
+    "worker_restarts": (int,),
+    "max_records_per_batch": (int,),
+}
+
+_UDF_MODES = ("inprocess", "worker")
 
 #: per-shard record contract (schema v3): field -> allowed types
 #: (shard None marks host-side ingest records)
@@ -118,6 +133,9 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
     if ver < 4 and "streaming" in e:
         _problem(out, path, lineno,
                  f"schema v{ver} record carries v4 field 'streaming'")
+    if ver < 5 and "udf" in e:
+        _problem(out, path, lineno,
+                 f"schema v{ver} record carries v5 field 'udf'")
     if ver < 3:
         return
     reorder = e.get("reorder")
@@ -171,6 +189,22 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
             if bad is not None:
                 _problem(out, path, lineno,
                          f"malformed streaming record ({bad}): {s!r}")
+    if ver >= 5:
+        u = e.get("udf")
+        if u is not None:
+            bad = None
+            if not isinstance(u, dict):
+                bad = "not a dict"
+            else:
+                for field, types in _UDF_FIELDS.items():
+                    if not isinstance(u.get(field), types):
+                        bad = f"field {field!r} not {types}"
+                        break
+                if bad is None and u.get("mode") not in _UDF_MODES:
+                    bad = f"mode {u.get('mode')!r} not in {_UDF_MODES}"
+            if bad is not None:
+                _problem(out, path, lineno,
+                         f"malformed udf record ({bad}): {u!r}")
 
 
 def _log_files(targets):

@@ -80,9 +80,15 @@
 #      arbiter lease pool drained to idle, and an immediate identical
 #      re-run at golden parity — the query-lifecycle hard guarantee
 #      (execution/lifecycle.py) end to end over HTTP
+#  15. python-UDF worker smoke: the out-of-process Arrow lane
+#      (spark_tpu.sql.udf.mode=worker) must match the in-process lane
+#      byte-for-byte across scalar + pandas UDFs, an injected
+#      udf_batch:fatal SIGKILL mid-batch must replay EXACTLY one
+#      batch (rec_chunks_replayed delta 1) at parity, and after pool
+#      shutdown ZERO worker children may survive
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-13 still run) for
+#   --fast skips the full pytest suite (stages 2-15 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -95,7 +101,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/14: tier-1 test suite --"
+    echo "-- stage 1/15: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -109,16 +115,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/14: SKIPPED (--fast) --"
+    echo "-- stage 1/15: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/14: dryrun_multichip(8) --"
+echo "-- stage 2/15: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/14: bench smoke --"
+echo "-- stage 3/15: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -150,7 +156,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/14: chaos smoke --"
+echo "-- stage 4/15: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -204,7 +210,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/14: observability + analysis smoke --"
+echo "-- stage 5/15: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -297,10 +303,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/14: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/15: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/14: SQL service smoke --"
+echo "-- stage 7/15: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -374,7 +380,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/14: join-kernel + ingest parity smoke --"
+echo "-- stage 8/15: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -432,7 +438,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/14: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/15: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -476,7 +482,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/14: elastic mesh smoke --"
+echo "-- stage 10/15: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -526,7 +532,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/14: streaming durability smoke --"
+echo "-- stage 11/15: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -619,7 +625,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/14: concurrency smoke --"
+echo "-- stage 12/15: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -702,7 +708,7 @@ print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
 
-echo "-- stage 13/14: compile-cache smoke --"
+echo "-- stage 13/15: compile-cache smoke --"
 # Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
 # subprocess over the same dir must open warm (disk_hits >= 1, ZERO
 # disk misses = no backend recompiles of cached shapes) with
@@ -799,7 +805,7 @@ print(json.dumps({"preflight_compile_cache_smoke": "ok",
                   "corrupt_recovered": fixed["corrupt"]}))
 EOF11
 
-echo "-- stage 14/14: query-lifecycle cancellation smoke --"
+echo "-- stage 14/15: query-lifecycle cancellation smoke --"
 # Start a chunked Q3 via the service, DELETE it mid-stream, assert the
 # structured error + no thread leak + arbiter drained + an immediate
 # clean re-run at golden parity (the cancellation hard guarantee).
@@ -894,5 +900,70 @@ finally:
 print(json.dumps({"preflight_cancellation_smoke": "ok",
                   "cancel_latency_s": round(latency_s, 3)}))
 EOF12
+
+echo "-- stage 15/15: python-UDF worker pool smoke --"
+# Worker-lane parity with in-process, an injected SIGKILL mid-batch
+# replaying exactly one batch, and the zero-leaked-children contract.
+env JAX_PLATFORMS=cpu python - <<'EOF13'
+import json
+
+import numpy as np
+import pandas as pd
+
+from spark_tpu import SparkTpuSession
+from spark_tpu.functions import col, pandas_udf, udf
+from spark_tpu.testing import faults
+
+s = SparkTpuSession.builder().get_or_create()
+s.conf.set("spark_tpu.sql.udf.arrow.maxRecordsPerBatch", 64)
+pdf = pd.DataFrame({
+    "x": np.where(np.arange(256) % 7 == 0, np.nan,
+                  np.arange(256, dtype="float64")),
+    "s": [None if i % 5 == 0 else f"v{i}" for i in range(256)]})
+s.register_table("udf_pf", pdf)
+
+plus = udf(lambda v: None if v is None else v + 1.5, "double")
+shout = udf(lambda v: None if v is None else v.upper(), "string")
+
+
+@pandas_udf(returnType="double")
+def scaled(v: pd.Series) -> pd.Series:
+    return v * 3.0
+
+
+def run():
+    return s.table("udf_pf").select(
+        plus(col("x")).alias("a"), shout(col("s")).alias("b"),
+        scaled(col("x")).alias("c")).to_pandas()
+
+
+s.conf.set("spark_tpu.sql.udf.mode", "inprocess")
+want = run()
+s.conf.set("spark_tpu.sql.udf.mode", "worker")
+got = run()
+pd.testing.assert_frame_equal(got, want)
+
+# SIGKILL mid-batch: exactly ONE batch replays, results identical
+replayed0 = s.metrics.counter("rec_chunks_replayed").value
+restarts0 = s.metrics.counter("udf_worker_restarts").value
+with faults.inject(s.conf, "udf_batch:fatal:2") as plan:
+    chaos = run()
+    assert plan.fired_log == [("udf_batch", 2, "fatal")], plan.fired_log
+pd.testing.assert_frame_equal(chaos, want)
+replayed = s.metrics.counter("rec_chunks_replayed").value - replayed0
+assert replayed == 1, f"expected exactly 1 replayed batch, got {replayed}"
+assert s.metrics.counter("udf_worker_restarts").value - restarts0 == 1
+
+# zero leaked children after shutdown
+s._udf_pool.shutdown()
+leaked = [p.pid for p in s._udf_pool.child_procs() if p.poll() is None]
+assert not leaked, f"leaked udf workers: {leaked}"
+print(json.dumps({
+    "preflight_udf_worker_smoke": "ok",
+    "udf_batches": int(s.metrics.counter("udf_batches").value),
+    "udf_rows": int(s.metrics.counter("udf_rows").value),
+    "replayed_batches": int(replayed),
+    "workers_spawned": len(s._udf_pool.child_procs())}))
+EOF13
 
 echo "== preflight PASSED =="

@@ -143,6 +143,13 @@ LOCKS: Tuple[LockDecl, ...] = (
              "(cross-process safety is atomic renames); pure file "
              "I/O inside — counters inc and fault seams fire OUTSIDE "
              "it, so nothing nests under it"),
+    LockDecl("udf.pool", "spark_tpu/udf_worker/pool.py", "UdfWorkerPool",
+             "_cv", "condition", 59,
+             "UDF worker checkout/checkin (cv: checkouts beyond "
+             "maxWorkers wait for a checkin); list/counter ops only "
+             "inside — spawns, kills, chaos seams and lifecycle "
+             "checkpoints all run OUTSIDE it (ranked above faults.plan "
+             "so no seam may fire under it)"),
     LockDecl("metrics.registry", _OBS + "metrics.py", "MetricsRegistry",
              "_lock", "lock", 60, "metric instrument map"),
     LockDecl("metrics.flush", _OBS + "metrics.py", "MetricsRegistry",
@@ -229,6 +236,13 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
     GuardDecl(_OBS + "listener.py", "ListenerBus", "_listeners",
               "_lock"),
     GuardDecl(_OBS + "listener.py", "ListenerBus", "dropped", "_lock"),
+    # udf worker pool
+    GuardDecl("spark_tpu/udf_worker/pool.py", "UdfWorkerPool", "_idle",
+              "_cv"),
+    GuardDecl("spark_tpu/udf_worker/pool.py", "UdfWorkerPool", "_live",
+              "_cv"),
+    GuardDecl("spark_tpu/udf_worker/pool.py", "UdfWorkerPool", "_all",
+              "_cv"),
     # faults
     GuardDecl("spark_tpu/testing/faults.py", "FaultPlan", "hits",
               "_lock"),
@@ -311,6 +325,15 @@ WAIVERS: Tuple[Waiver, ...] = (
            "mutated only by the test harness thread during "
            "install()/uninstall(), before/after the watched "
            "concurrency runs"),
+    Waiver("spark_tpu/udf_worker/pool.py", "UdfWorkerPool",
+           "max_workers",
+           "GIL-atomic scalar refresh from conf at each worker-mode "
+           "evaluation entry (python_eval.session_pool); checkout "
+           "reads a point-in-time bound"),
+    Waiver("spark_tpu/udf_worker/pool.py", "UdfWorkerPool",
+           "idle_timeout_ms",
+           "GIL-atomic scalar refresh from conf, same discipline as "
+           "max_workers"),
 )
 
 #: classes in shared modules whose instances are thread-confined —
@@ -329,6 +352,10 @@ CONFINED: Tuple[ConfinedDecl, ...] = (
     ConfinedDecl("spark_tpu/parallel/elastic.py", "RebalanceState",
                  "ContextVar-installed per stream; on_straggler posts "
                  "synchronously on the driver thread"),
+    ConfinedDecl("spark_tpu/udf_worker/pool.py", "WorkerHandle",
+                 "checked out to exactly one query thread at a time; "
+                 "the hand-off back into the pool's idle list happens "
+                 "under the pool cv, which orders the threads"),
 )
 
 #: module-level global waivers live in WAIVERS with cls="". This alias
@@ -386,6 +413,10 @@ CONTEXT_MANAGERS: Dict[Tuple[str, str], str] = {
 CALLED_WITH_LOCK_HELD: Dict[Tuple[str, str, str], str] = {
     ("spark_tpu/observability/straggler.py", "StragglerMonitor",
      "_evaluate"): "_lock",
+    # checkout's reap step: the lexical `with self._cv` lives in
+    # checkout; _reap_locked only mutates _idle/_live under it
+    ("spark_tpu/udf_worker/pool.py", "UdfWorkerPool",
+     "_reap_locked"): "_cv",
 }
 
 #: acquisition-order edges the lexical extractor cannot see (locks
@@ -447,6 +478,10 @@ EXTRA_EDGES: Tuple[Tuple[str, str, str], ...] = (
      "cancel_point seam while holding the slot cv"),
     ("service.arbiter", "faults.plan", "lease-wait wakeups fire the "
      "cancel_point seam while holding the lease cv"),
+    # the out-of-process UDF lane checks workers out while the query
+    # runs under its session lease (execution/python_eval.py)
+    ("service.session", "udf.pool", "worker checkout/checkin during "
+     "UDF evaluation under the lease"),
 )
 
 

@@ -65,6 +65,11 @@ FINDING_CODES: Dict[str, tuple] = {
         CAT_HOST_SYNC, "warn",
         "Python UDF in the plan: the stage splits around a "
         "device->host->device round trip per batch"),
+    "UDF_SCALAR_LARGE_INPUT": (
+        CAT_HOST_SYNC, "info",
+        "a scalar (row-at-a-time) Python UDF sits over a large scan: "
+        "every row crosses the interpreter individually — @pandas_udf "
+        "runs the same logic vectorized over whole Arrow batches"),
     "GENERATE_MESH_MATERIALIZE": (
         CAT_HOST_SYNC, "warn",
         "explode/generate under a mesh materializes its subtree "

@@ -224,15 +224,79 @@ def _check_agg_overflow(node: P.HashAggregateExec, out: List[Finding],
                         "acc_bits": _ACC_BITS, "agg": repr(f)}))
 
 
+#: scan bound above which a row-at-a-time UDF's per-row interpreter
+#: crossings dominate the stage (the @pandas_udf suggestion threshold)
+_UDF_SCALAR_LARGE_ROWS = 1 << 16
+
+
+def _check_udf_roundtrip(root: P.PhysicalPlan, conf,
+                         out: List[Finding]) -> None:
+    """UDF_HOST_ROUNDTRIP with a batch-count/bytes prediction derived
+    from scan estimates (graded by history.prediction_report against
+    the observed `udf_batches`/`udf_rows` counters), plus an info note
+    per scalar UDF sitting over a large scan."""
+    from ..execution.python_eval import node_udfs
+    max_rec = int(conf.get(
+        "spark_tpu.sql.udf.arrow.maxRecordsPerBatch"))
+    rows_total = 0
+    bytes_total = 0
+    udf_nodes = 0
+    scalar_large: List[tuple] = []
+    seen = set()
+
+    def walk(node):
+        nonlocal rows_total, bytes_total, udf_nodes
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for c in node.children:
+            walk(c)
+        udfs = node_udfs(node)
+        if not udfs:
+            return
+        udf_nodes += 1
+        src = node.children[0] if node.children else node
+        rows = _estimate_rows(src)
+        if rows is None or rows <= 0:
+            return
+        rows_total += rows
+        try:
+            width = 8 * max(1, len(src.schema().fields))
+        except Exception:  # noqa: BLE001 — width is best-effort
+            width = 8
+        bytes_total += rows * width
+        for u in udfs:
+            if not u.vectorized and rows >= _UDF_SCALAR_LARGE_ROWS:
+                scalar_large.append((u.udf_name, int(rows), node))
+
+    walk(root)
+    if not udf_nodes:
+        return
+    detail = {"max_records_per_batch": max_rec}
+    msg = ("plan contains Python UDFs: the stage splits around a "
+           "device->host->device round trip per batch")
+    if rows_total:
+        detail.update(
+            rows_bound=int(rows_total),
+            batches_bound=int(-(-rows_total // max_rec)),
+            bytes_bound=int(bytes_total))
+        msg += (f" (~{detail['batches_bound']:,} batches of <= "
+                f"{max_rec:,} rows, ~{rows_total:,} rows round-tripped)")
+    out.append(Finding("UDF_HOST_ROUNDTRIP", msg,
+                       op=_node_loc(root), detail=detail))
+    for name, rows, node in scalar_large:
+        out.append(Finding(
+            "UDF_SCALAR_LARGE_INPUT",
+            f"{name}: scalar UDF over ~{rows:,} input rows crosses "
+            f"the interpreter once per row; @pandas_udf evaluates the "
+            f"same logic once per <= {max_rec:,}-row Arrow batch",
+            op=_node_loc(node),
+            detail={"rows_bound": int(rows), "udf": name}))
+
+
 def _check_host_sync(root: P.PhysicalPlan, conf,
                      mesh_n: int, out: List[Finding]) -> None:
-    from ..execution.python_eval import plan_has_udfs
-    if plan_has_udfs(root):
-        out.append(Finding(
-            "UDF_HOST_ROUNDTRIP",
-            "plan contains Python UDFs: the stage splits around a "
-            "device->host->device round trip per batch",
-            op=_node_loc(root)))
+    _check_udf_roundtrip(root, conf, out)
 
     chunk_rows = int(conf.get(
         "spark_tpu.sql.execution.streamingChunkRows"))

@@ -67,7 +67,48 @@ def predict_plan(root: P.PhysicalPlan, conf, mesh_n: int = 1
             pass
 
     walk(root)
+    try:
+        _predict_udf(root, conf, out)
+    except Exception:  # noqa: BLE001 — advisory only
+        pass
     return out
+
+
+def _predict_udf(root: P.PhysicalPlan, conf, out: List[dict]) -> None:
+    """Predicted Arrow batch/row traffic through the UDF worker lane,
+    graded against the observed `udf_batches`/`udf_rows` counters.
+    Worker mode only: the in-process lane evaluates whole batches and
+    never slices, so the batch count is not a prediction there."""
+    if str(conf.get("spark_tpu.sql.udf.mode") or "inprocess") != "worker":
+        return
+    from ..execution.python_eval import node_udfs
+    max_rec = int(conf.get(
+        "spark_tpu.sql.udf.arrow.maxRecordsPerBatch"))
+    rows_total = 0
+    seen = set()
+
+    def walk(node):
+        nonlocal rows_total
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for c in node.children:
+            walk(c)
+        if not node_udfs(node):
+            return
+        rows = _estimate_rows(node.children[0] if node.children
+                              else node)
+        if rows is not None and rows > 0:
+            rows_total += rows
+
+    walk(root)
+    if rows_total <= 0:
+        return
+    out.append({"kind": "udf_rows", "tag": "udf",
+                "predicted": int(rows_total), "basis": "scan-estimate"})
+    out.append({"kind": "udf_batches", "tag": "udf",
+                "predicted": int(-(-rows_total // max_rec)),
+                "basis": f"rows/{max_rec}"})
 
 
 def _predict_node(node, out: List[dict], mesh_n: int) -> None:

@@ -523,11 +523,22 @@ class GroupedData:
                                               for g in self._groups}])
         ).to_pandas() if self._groups else self._df.to_pandas()
         if key_names:
-            pieces = [fn(g.reset_index(drop=True))
+            groups = [g.reset_index(drop=True)
                       for _, g in pdf.groupby(key_names, sort=False,
                                               dropna=False)]
         else:
-            pieces = [fn(pdf)]
+            groups = [pdf]
+        mode = str(self._df.session.conf.get(
+            "spark_tpu.sql.udf.mode") or "inprocess")
+        if mode == "worker":
+            # out-of-process lane: one EVAL frame per key group through
+            # the session's worker pool (FlatMapGroupsInPandasExec)
+            from .execution.python_eval import eval_grouped_map_worker
+            pieces = eval_grouped_map_worker(
+                self._df.session, fn, groups,
+                [f.name for f in out_schema.fields])
+        else:
+            pieces = [fn(g) for g in groups]
         out = pd.concat(pieces, ignore_index=True) if pieces else \
             pd.DataFrame({f.name: [] for f in out_schema.fields})
         out = out[[f.name for f in out_schema.fields]]

@@ -88,6 +88,10 @@ class QueryExecution:
         # the analyzer ran for this execution
         self.analysis_findings: Optional[list] = None
         self._analysis_posted = False
+        # python-UDF evaluation summary (execution/python_eval.py):
+        # the event-log `udf` record — mode, batch/row totals, worker
+        # restarts; None when the query had no UDFs
+        self.udf_summary: Optional[Dict] = None
 
     @property
     def _conf(self):
@@ -993,6 +997,7 @@ class QueryExecution:
         conf = self._conf
         self.fault_summary = {}
         self.fault_events = []
+        self.udf_summary = None
         self._recovery = RecoveryContext(metrics=self.session.metrics,
                                          record=self._record_fault)
         # NOTE: _analysis_posted is NOT reset here — it is
@@ -1104,6 +1109,13 @@ class QueryExecution:
         self._record_fault("cancel", e)
         self.spans.mark("cancelled",
                         reason="cancel" if cancelled else "deadline")
+        # the no-orphan contract holds wherever the cancel lands: even
+        # when it hits outside the UDF lane (scan, exchange, a chunked
+        # aggregate), no pooled UDF worker survives the query — idle
+        # workers respawn on demand, so this only costs a warm start
+        pool = getattr(self.session, "_udf_pool", None)
+        if pool is not None:
+            pool.shutdown()
         self._post_query_end(None, status=status, error=e)
 
     def _mesh_replan(self, mesh_size: Optional[int] = None) -> None:
@@ -1415,7 +1427,8 @@ class QueryExecution:
         from .python_eval import extract_python_udfs, plan_has_udfs
         if plan_has_udfs(root0):
             t0 = time.perf_counter()
-            root0 = extract_python_udfs(root0, self.session.conf)
+            root0 = extract_python_udfs(root0, self.session.conf,
+                                        qe=self)
             self.phase_times["python_udfs"] = time.perf_counter() - t0
         if mesh is not None:
             root0 = self._materialize_generates(root0)
@@ -1828,6 +1841,11 @@ class QueryExecution:
             # history.read_event_log; bench counts them per query)
             event["analysis_findings"] = [
                 f.to_dict() for f in self.analysis_findings]
+        if self.udf_summary:
+            # python-UDF lane record (schema v5): mode + batch/row
+            # totals + worker restarts (history.prediction_report
+            # grades udf_batches/udf_rows predictions against these)
+            event["udf"] = dict(self.udf_summary)
         if self.fault_summary:
             # every retry/eviction/degradation/fallback this
             # execution survived (history.fault_summary reads these)

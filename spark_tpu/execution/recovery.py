@@ -124,6 +124,12 @@ class ChunkRetrier:
                 # proves each KNOWN_SITE has a wired fire() seam.
                 if self.site == "ingest_prefetch":
                     faults.fire("ingest_prefetch")
+                elif self.site == "udf_batch":
+                    # seam fires INSIDE the step (python_eval's worker
+                    # lane): the step must kill the in-flight worker
+                    # before the injected error surfaces, so the
+                    # fatal rule models a real SIGKILL mid-batch
+                    pass
                 else:
                     faults.fire("stream_chunk")
                 return step()

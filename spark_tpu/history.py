@@ -41,7 +41,8 @@ def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
 
 #: event fields kept nested (object columns) rather than flattened
 _NESTED = ("spans", "stages", "shards", "predictions",
-           "analysis_findings", "plan_tree", "reorder", "streaming")
+           "analysis_findings", "plan_tree", "reorder", "streaming",
+           "udf")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
@@ -339,7 +340,12 @@ def straggler_report(events: pd.DataFrame, factor: Optional[float] = None,
 _PRED_OBSERVED = {"exch_rows": "exch_rows_{tag}",
                   "exch_bytes": "exch_bytes_{tag}",
                   "join_rows": "join_rows_{tag}",
-                  "agg_groups": "agg_groups_{tag}"}
+                  "agg_groups": "agg_groups_{tag}",
+                  # worker-lane UDF traffic: untagged counters, so the
+                  # pattern is the metric name itself (schema v5 also
+                  # mirrors them in the nested `udf` record)
+                  "udf_rows": "udf_rows",
+                  "udf_batches": "udf_batches"}
 
 
 def grade_predictions(predictions, metrics) -> List[dict]:
@@ -401,6 +407,17 @@ def prediction_report(events: pd.DataFrame) -> pd.DataFrame:
                    if c not in metric_skip and c not in _NESTED
                    and not isinstance(r[c], (list, dict))
                    and pd.notna(r[c])}
+        u = r.get("udf") if "udf" in events.columns else None
+        if isinstance(u, dict):
+            # the nested `udf` record (schema v5) carries the same
+            # totals as the udf_* counters; merge them in (counters
+            # win) so udf_batches/udf_rows predictions grade even on
+            # logs where the metrics channel was trimmed
+            for rec_key, col in (("batches", "udf_batches"),
+                                 ("rows", "udf_rows")):
+                v = u.get(rec_key)
+                if v is not None and col not in metrics:
+                    metrics[col] = v
         base = {"ts": r.get("ts"), "app": r.get("app"),
                 "query_id": r.get("query_id")}
         preds = r.get("predictions") if "predictions" in events.columns \

@@ -94,6 +94,13 @@ METRIC_PREFIXES = (
     "query_cancelled",       # queries stopped by cancel()/DELETE
     "query_deadline_",       # query_deadline_exceeded: blown budgets
     "session_quota_",        # session_quota_rejections
+    # out-of-process python UDF lane (udf_worker/ +
+    # execution/python_eval.py worker mode): REGISTRY counters, listed
+    # for namespace closure — batches/rows streamed through the pool,
+    # cumulative in-worker wall-clock, workers killed+replaced after a
+    # crash/timeout, and spawn+handshake wall-clock
+    "udf_",            # udf_batches/udf_rows/udf_exec_ms/
+                       # udf_worker_restarts/udf_worker_spawn_ms
 )
 
 

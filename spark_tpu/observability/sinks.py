@@ -21,9 +21,11 @@ from .spans import to_chrome_trace
 # runtime-annotated `plan_tree`, and `predictions` (analyzer
 # self-grading). v4: the per-batch `streaming` record (micro-batch
 # lifecycle: offsets, delta-vs-snapshot state bytes, quarantines).
-# Purely additive — older logs replay unchanged
-# (scripts/events_tool.py validates every published version).
-EVENT_LOG_SCHEMA_VERSION = 4
+# v5: the per-query `udf` record (lane mode, Arrow batch/row totals,
+# exec ms, worker restarts). Purely additive — older logs replay
+# unchanged (scripts/events_tool.py validates every published
+# version).
+EVENT_LOG_SCHEMA_VERSION = 5
 
 
 def json_default(o):

@@ -64,6 +64,7 @@ from ..observability import ListenerBus, MetricsRegistry, QueryListener
 from ..observability.listener import ServiceEvent
 from ..observability.sinks import json_default
 from ..sql.lexer import ParseError
+from ..udf_worker import UdfError
 from .admission import (SESSION_MAX_CONCURRENT_KEY, AdmissionController,
                         AdmissionError, AdmissionRejected,
                         AdmissionTimeout, SessionQuota)
@@ -421,9 +422,14 @@ class SqlService:
             record["status"] = "error"
             code = ("INVALID_SQL"
                     if isinstance(e, (ParseError, AnalysisError))
+                    else "UDF_ERROR" if isinstance(e, UdfError)
                     else "EXECUTION_ERROR")
             record["error"] = {"error": code,
                                "message": f"{type(e).__name__}: {e}"[:400]}
+            if isinstance(e, UdfError):
+                # the USER traceback captured inside the worker child —
+                # the client debugs their lambda, not our pool framing
+                record["error"]["traceback"] = e.worker_traceback
             record["finished_ts"] = time.time()
             self.metrics.counter("service_failed").inc()
             self._post("failed", rid, detail=type(e).__name__,
@@ -538,10 +544,13 @@ class SqlService:
                 record["status"] = "error"
                 code = ("INVALID_SQL"
                         if isinstance(e, (ParseError, AnalysisError))
+                        else "UDF_ERROR" if isinstance(e, UdfError)
                         else "EXECUTION_ERROR")
                 record["error"] = {
                     "error": code,
                     "message": f"{type(e).__name__}: {e}"[:400]}
+                if isinstance(e, UdfError):
+                    record["error"]["traceback"] = e.worker_traceback
                 self.metrics.counter("service_failed").inc()
                 self._post("failed", record["id"], session=session)
             finally:
@@ -922,6 +931,15 @@ def _make_handler(service: SqlService):
                 self._send_json(504, {
                     "error": "QUERY_DEADLINE_EXCEEDED",
                     "message": f"{type(e).__name__}: {e}"[:400]})
+                return
+            except UdfError as e:
+                # user code raised inside a UDF worker: the query is at
+                # fault, not the engine — 400-class, with the worker-
+                # captured USER traceback in the structured body
+                self._send_json(400, {
+                    "error": "UDF_ERROR",
+                    "message": f"{type(e).__name__}: {e}"[:400],
+                    "traceback": e.worker_traceback})
                 return
             except Exception as e:  # noqa: BLE001 — structured surface
                 self._send_json(500, {

@@ -92,6 +92,18 @@ class SparkTpuSession(metaclass=_ActiveSessionMeta):
         self._aqe_caps: Dict[str, Dict[str, int]] = {}
         from .udf import UDFRegistration
         self.udf = UDFRegistration(self)
+        # out-of-process UDF worker pool (udf_worker/pool.py): created
+        # eagerly (a pool object spawns nothing until first checkout)
+        # so lockwatch can wrap its cv at session install time; bounds
+        # are refreshed from conf at each worker-mode evaluation.
+        # Workers are reused across this session's queries; idle ones
+        # reap after udf.pool.idleTimeoutMs, and a worker's stdin EOF
+        # on process exit ends the child, so none outlives the engine.
+        from .udf_worker.pool import UdfWorkerPool
+        self._udf_pool = UdfWorkerPool(
+            int(self.conf.get("spark_tpu.sql.udf.pool.maxWorkers")),
+            float(self.conf.get("spark_tpu.sql.udf.pool.idleTimeoutMs")),
+            metrics=self.metrics)
         if register_active:
             SparkTpuSession._active = self
 

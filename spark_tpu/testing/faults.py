@@ -19,6 +19,7 @@ Grammar (comma-separated rules):
              | decommission | stream_source_list
              | stream_offset_write | stream_state_commit
              | stream_sink_emit | compile_cache_load | cancel_point
+             | udf_batch | udf_worker_spawn
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
              | cancel
@@ -90,6 +91,16 @@ cancellation at exactly the nth boundary a query crosses: the
 cancel-point chaos matrix (tests/test_lifecycle.py) sweeps `n` across
 execution shapes to prove every boundary releases its resources.
 
+`udf_batch` fires once per batch ATTEMPT inside the out-of-process UDF
+lane's per-slice retry loop (execution/python_eval.py worker mode —
+the seam sits inside the ChunkRetrier step, so replays re-fire). A
+`fatal` rule there is special-cased by the lane into a real
+SIGKILL-mid-batch model: the in-flight worker is killed and the error
+surfaces as UdfWorkerLost (UNAVAILABLE -> TRANSIENT), proving exactly
+one batch replays on a fresh worker. `udf_worker_spawn` fires before
+each worker subprocess exec (udf_worker/pool.py), so spawn failures
+ride the same batch-replay path.
+
 The `slow` fault sleeps on the INTERRUPTIBLE lifecycle wait, not a
 bare time.sleep: a cancel/deadline delivered mid-sleep wakes it
 immediately (raising the structured lifecycle error), so cancel-matrix
@@ -116,7 +127,7 @@ KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "decommission", "stream_source_list",
                "stream_offset_write", "stream_state_commit",
                "stream_sink_emit", "compile_cache_load",
-               "cancel_point")
+               "cancel_point", "udf_batch", "udf_worker_spawn")
 
 #: sites that fire INSIDE a stage trace (once per (re)compile of the
 #: enclosing stage). The persistent compile cache consults this: a

@@ -219,6 +219,7 @@ class LockWatch:
         self.watch_attr(session.listeners, "_lock", "obs.bus")
         self.watch_attr(session.metrics, "_lock", "metrics.registry")
         self.watch_attr(session.metrics, "_flush_lock", "metrics.flush")
+        self.watch_attr(session._udf_pool, "_cv", "udf.pool")
         for li in session.listeners.listeners:
             if isinstance(li, EventLogListener):
                 self.watch_attr(li, "_write_lock", "obs.event_log")

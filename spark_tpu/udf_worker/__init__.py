@@ -1,0 +1,31 @@
+"""Out-of-process Python UDF worker pool (the `ArrowEvalPythonExec` /
+`PythonRunner.scala:84` seat): Arrow-batched evaluation of user Python
+in reusable CPython subprocesses, with batch-granular retry, cancel,
+and observability. See pool.py (parent side), worker.py (child loop),
+protocol.py (framing). Selected by `spark_tpu.sql.udf.mode = worker`;
+the default `inprocess` keeps the original single-process lane.
+
+This package __init__ stays import-light: the SQL service imports
+`UdfError` from here for its error mapping, and must not drag the pool
+machinery (or pyarrow) in before it needs it.
+"""
+
+from __future__ import annotations
+
+
+class UdfError(RuntimeError):
+    """User code raised inside a UDF worker. Carries the USER traceback
+    captured in the child (not the pool's framing stack), surfaces as
+    the structured `UDF_ERROR` service code (HTTP 400-class: the query
+    is at fault, not the engine), and classifies FATAL — a user bug
+    never burns retry budget."""
+
+    code = "UDF_ERROR"
+
+    def __init__(self, udf_name: str, etype: str, message: str,
+                 worker_traceback: str):
+        super().__init__(
+            f"python UDF {udf_name!r} raised {etype}: {message}")
+        self.udf_name = udf_name
+        self.etype = etype
+        self.worker_traceback = worker_traceback
