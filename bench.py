@@ -848,6 +848,137 @@ def bench_streaming(spark):
     return out
 
 
+def bench_streaming_network(spark):
+    """Unattended-streaming section: the socket network source
+    (io/network_source.py) driven by an in-process FrameProducer.
+    Sidecars: `streaming_net_rows_per_s_f<N>` (end-to-end micro-batch
+    throughput — wire transfer + durable frame persistence + stateful
+    fold — at two frame sizes: small frames bound replay cost, large
+    frames amortize the round-trip), `streaming_net_reconnect_ms`
+    (wall-clock from a mid-stream connection kill to the next batch
+    committed over a fresh handshake) with the observed
+    `streaming_reconnects` delta, and the host-spill tier:
+    `streaming_spilled_ms` vs `streaming_resident_ms` for the SAME
+    event-time stream (the spill tax), `streaming_spill_bytes` and the
+    `streaming_spill_parity` byte-identical check."""
+    import tempfile
+
+    import pandas as pd
+
+    from spark_tpu import functions as F
+    from spark_tpu.functions import col
+    from spark_tpu.io.network_source import FrameProducer
+    from spark_tpu.streaming import (SPILL_BYTES_KEY, SPILL_PARTS_KEY,
+                                     MemoryStream)
+
+    base = tempfile.mkdtemp(prefix="bench_stream_net_")
+    schema = pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                           "v": pd.Series([], dtype=np.int64)})
+    rng = np.random.RandomState(13)
+    out = {}
+
+    # -- throughput at two frame sizes
+    n_frames = 8
+    for rows in (4096, 65536):
+        prod = FrameProducer()
+        port = prod.start()
+        try:
+            src = spark.network_stream("127.0.0.1", port, schema)
+            q = (src.to_df()
+                 .group_by(F.pmod(col("k"), 1024).alias("g"))
+                 .agg(F.sum(col("v")).alias("s"))
+                 .write_stream(os.path.join(base, f"ck_{rows}")))
+            frames = [pd.DataFrame(
+                {"k": rng.randint(0, 1 << 20, rows).astype(np.int64),
+                 "v": np.ones(rows, np.int64)})
+                for _ in range(n_frames)]
+            prod.send(frames[0])
+            q.process_available()  # warmup: compile + first handshake
+            t0 = time.perf_counter()
+            for d in frames[1:]:
+                prod.send(d)
+            q.process_available()
+            dt = time.perf_counter() - t0
+            out[f"streaming_net_rows_per_s_f{rows}"] = round(
+                rows * (n_frames - 1) / dt, 1)
+            src.close()
+        finally:
+            prod.close()
+
+    # -- reconnect recovery latency (kill mid-stream, fresh handshake)
+    prod = FrameProducer()
+    port = prod.start()
+    try:
+        rc0 = spark.metrics.counter("streaming_reconnects").value
+        src = spark.network_stream("127.0.0.1", port, schema)
+        q = (src.to_df().filter(col("v") >= 0)
+             .write_stream(os.path.join(base, "ck_rc"),
+                           output_mode="append"))
+        d = pd.DataFrame({"k": np.arange(4096, dtype=np.int64),
+                          "v": np.ones(4096, np.int64)})
+        prod.send(d)
+        q.process_available()
+        prod.kill_connection()
+        prod.send(d)
+        t0 = time.perf_counter()
+        q.process_available()
+        out["streaming_net_reconnect_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1)
+        out["streaming_reconnects"] = int(
+            spark.metrics.counter("streaming_reconnects").value - rc0)
+        src.close()
+    finally:
+        prod.close()
+
+    # -- host-spill tier: spilled vs resident timing + output parity
+    def event_rounds():
+        ts0 = pd.Timestamp("2024-01-01")
+        return [pd.DataFrame(
+            {"ts": ts0 + pd.to_timedelta(
+                rng.randint(0, 1280, 4096), unit="s"),
+             "v": np.ones(4096)}) for _ in range(4)]
+
+    rng = np.random.RandomState(13)
+    rounds_r = event_rounds()
+    rng = np.random.RandomState(13)
+    rounds_s = event_rounds()  # identical data for both runs
+
+    def run_event(tag, rounds):
+        src = MemoryStream(spark, pd.DataFrame(
+            {"ts": [pd.Timestamp("2024-01-01")], "v": [0.0]}))
+        q = (src.to_df().with_watermark("ts", "10 seconds")
+             .group_by(F.window(col("ts"), "10 seconds").alias("w"))
+             .agg(F.sum(col("v")).alias("s"))
+             .write_stream(os.path.join(base, f"ck_{tag}")))
+        src.add_data(rounds[0])
+        q.process_available()  # warmup batch
+        t0 = time.perf_counter()
+        for d in rounds[1:]:
+            src.add_data(d)
+            q.process_available()
+        return q, time.perf_counter() - t0
+
+    q_r, dt_r = run_event("resident", rounds_r)
+    old_spill = spark.conf.get(SPILL_BYTES_KEY)
+    old_parts = spark.conf.get(SPILL_PARTS_KEY)
+    sp0 = spark.metrics.counter("streaming_spill_bytes").value
+    try:
+        spark.conf.set(SPILL_BYTES_KEY, 1)
+        spark.conf.set(SPILL_PARTS_KEY, 16)
+        q_s, dt_s = run_event("spilled", rounds_s)
+    finally:
+        spark.conf.set(SPILL_BYTES_KEY, old_spill or 0)
+        spark.conf.set(SPILL_PARTS_KEY, old_parts or 16)
+    out["streaming_resident_ms"] = round(dt_r * 1e3, 1)
+    out["streaming_spilled_ms"] = round(dt_s * 1e3, 1)
+    out["streaming_spill_bytes"] = int(
+        spark.metrics.counter("streaming_spill_bytes").value - sp0)
+    a = q_r.latest().sort_values("w").reset_index(drop=True)
+    b = q_s.latest().sort_values("w").reset_index(drop=True)
+    out["streaming_spill_parity"] = bool(a.equals(b))
+    return out
+
+
 def bench_obs_overhead(spark):
     """Observability tax on the wall-clock (satellite of the flight
     -recorder PR): TPC-H Q1 at a small SF, warm, best-of-3, with ALL
@@ -1010,6 +1141,12 @@ def main():
     # state-store delta-vs-snapshot bytes + fresh-query restore cost
     extra.update(run_budgeted(
         "streaming", lambda: bench_streaming(spark),
+        min(budget, 240)))
+    emit_summary()
+    # unattended streaming: network-source throughput at two frame
+    # sizes, reconnect recovery latency, spilled-vs-resident state
+    extra.update(run_budgeted(
+        "streaming_network", lambda: bench_streaming_network(spark),
         min(budget, 240)))
     emit_summary()
     # Python-UDF lane: in-process vs Arrow worker pool rows/s at two

@@ -8,12 +8,13 @@ enforceable in CI:
 
     scripts/events_tool.py validate <file-or-dir> [...]
         Validate every app-*.jsonl line against the versioned schema.
-        Knows every published schema_version (1..5): v3 added the
+        Knows every published schema_version (1..6): v3 added the
         per-shard `shards` records, `plan_tree` and `predictions`;
         v4 added the per-micro-batch `streaming` record; v5 added the
-        per-query `udf` record (worker-lane batch/row totals) — purely
-        additive, so old logs must (and do) validate under their own
-        version's rules. Exits nonzero listing file:line: problem for
+        per-query `udf` record (worker-lane batch/row totals); v6
+        added the per-tick `trigger` record (supervised streaming
+        trigger loop) — purely additive, so old logs must (and do)
+        validate under their own version's rules. Exits nonzero listing file:line: problem for
         every violation.
 
     scripts/events_tool.py tail <file-or-dir> [-n N]
@@ -32,7 +33,7 @@ import json
 import os
 import sys
 
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 #: per-micro-batch streaming record contract (schema v4):
 #: field -> allowed types
@@ -64,6 +65,18 @@ _UDF_FIELDS = {
 }
 
 _UDF_MODES = ("inprocess", "worker")
+
+#: per-tick trigger record contract (schema v6): field -> allowed
+#: types (one record per supervised trigger-loop tick that ran
+#: batches, plus the parking tick of a FAILED query)
+_TRIGGER_FIELDS = {
+    "tick": (int,),
+    "skew_ms": (int, float),
+    "batches_run": (int,),
+    "restarts": (int,),
+    "source": (str,),
+    "reconnects": (int,),
+}
 
 #: per-shard record contract (schema v3): field -> allowed types
 #: (shard None marks host-side ingest records)
@@ -136,6 +149,9 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
     if ver < 5 and "udf" in e:
         _problem(out, path, lineno,
                  f"schema v{ver} record carries v5 field 'udf'")
+    if ver < 6 and "trigger" in e:
+        _problem(out, path, lineno,
+                 f"schema v{ver} record carries v6 field 'trigger'")
     if ver < 3:
         return
     reorder = e.get("reorder")
@@ -205,6 +221,20 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
             if bad is not None:
                 _problem(out, path, lineno,
                          f"malformed udf record ({bad}): {u!r}")
+    if ver >= 6:
+        t = e.get("trigger")
+        if t is not None:
+            bad = None
+            if not isinstance(t, dict):
+                bad = "not a dict"
+            else:
+                for field, types in _TRIGGER_FIELDS.items():
+                    if not isinstance(t.get(field), types):
+                        bad = f"field {field!r} not {types}"
+                        break
+            if bad is not None:
+                _problem(out, path, lineno,
+                         f"malformed trigger record ({bad}): {t!r}")
 
 
 def _log_files(targets):

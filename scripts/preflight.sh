@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Fourteen stages, all mandatory:
+# Sixteen stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -86,9 +86,18 @@
 #      udf_batch:fatal SIGKILL mid-batch must replay EXACTLY one
 #      batch (rec_chunks_replayed delta 1) at parity, and after pool
 #      shutdown ZERO worker children may survive
+#  16. unattended-streaming smoke: a socket FrameProducer feeds a
+#      stateful network-source query under the supervised trigger loop
+#      (start(trigger_ms=50)); the producer connection is killed
+#      mid-stream and the consumer must reconnect exactly once
+#      (streaming_reconnects delta 1) with zero loss/duplication; an
+#      injected trigger_tick:fatal must park the query in structured
+#      FAILED status; a FRESH query over the same checkpoint must
+#      recover byte-identical to an uninterrupted twin; and after a
+#      clean stop ZERO spark-tpu-stream-trigger threads may survive
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-15 still run) for
+#   --fast skips the full pytest suite (stages 2-16 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -101,7 +110,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/15: tier-1 test suite --"
+    echo "-- stage 1/16: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -115,16 +124,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/15: SKIPPED (--fast) --"
+    echo "-- stage 1/16: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/15: dryrun_multichip(8) --"
+echo "-- stage 2/16: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/15: bench smoke --"
+echo "-- stage 3/16: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -156,7 +165,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/15: chaos smoke --"
+echo "-- stage 4/16: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -210,7 +219,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/15: observability + analysis smoke --"
+echo "-- stage 5/16: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -303,10 +312,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/15: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/16: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/15: SQL service smoke --"
+echo "-- stage 7/16: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -380,7 +389,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/15: join-kernel + ingest parity smoke --"
+echo "-- stage 8/16: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -438,7 +447,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/15: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/16: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -482,7 +491,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/15: elastic mesh smoke --"
+echo "-- stage 10/16: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -532,7 +541,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/15: streaming durability smoke --"
+echo "-- stage 11/16: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -625,7 +634,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/15: concurrency smoke --"
+echo "-- stage 12/16: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -708,7 +717,7 @@ print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
 
-echo "-- stage 13/15: compile-cache smoke --"
+echo "-- stage 13/16: compile-cache smoke --"
 # Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
 # subprocess over the same dir must open warm (disk_hits >= 1, ZERO
 # disk misses = no backend recompiles of cached shapes) with
@@ -805,7 +814,7 @@ print(json.dumps({"preflight_compile_cache_smoke": "ok",
                   "corrupt_recovered": fixed["corrupt"]}))
 EOF11
 
-echo "-- stage 14/15: query-lifecycle cancellation smoke --"
+echo "-- stage 14/16: query-lifecycle cancellation smoke --"
 # Start a chunked Q3 via the service, DELETE it mid-stream, assert the
 # structured error + no thread leak + arbiter drained + an immediate
 # clean re-run at golden parity (the cancellation hard guarantee).
@@ -901,7 +910,7 @@ print(json.dumps({"preflight_cancellation_smoke": "ok",
                   "cancel_latency_s": round(latency_s, 3)}))
 EOF12
 
-echo "-- stage 15/15: python-UDF worker pool smoke --"
+echo "-- stage 15/16: python-UDF worker pool smoke --"
 # Worker-lane parity with in-process, an injected SIGKILL mid-batch
 # replaying exactly one batch, and the zero-leaked-children contract.
 env JAX_PLATFORMS=cpu python - <<'EOF13'
@@ -965,5 +974,115 @@ print(json.dumps({
     "replayed_batches": int(replayed),
     "workers_spawned": len(s._udf_pool.child_procs())}))
 EOF13
+
+echo "-- stage 16/16: unattended streaming smoke --"
+# Socket producer under the supervised trigger loop: a mid-stream
+# connection kill must reconnect exactly once with zero loss, an
+# injected trigger_tick fatal must park the query in structured FAILED,
+# a fresh query over the same checkpoint must land byte-identical to an
+# uninterrupted twin, and no trigger thread may outlive its query.
+env JAX_PLATFORMS=cpu python - <<'EOF14'
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+from spark_tpu import SparkTpuSession
+from spark_tpu import functions as F
+from spark_tpu.functions import col
+from spark_tpu.io.network_source import FrameProducer
+from spark_tpu.testing import faults
+from spark_tpu.testing.lockwatch import LockWatch
+
+spark = SparkTpuSession.builder().get_or_create()
+base = tempfile.mkdtemp(prefix="preflight_unattended_")
+
+SCHEMA = pd.DataFrame({"k": pd.Series([], dtype=np.int64),
+                       "v": pd.Series([], dtype=np.int64)})
+
+
+def round_df(i):
+    return pd.DataFrame({"k": np.arange(6, dtype=np.int64) + i,
+                         "v": np.arange(6, dtype=np.int64) * (i + 1)})
+
+
+def build(producer, ck):
+    src = spark.network_stream("127.0.0.1", producer.port, SCHEMA)
+    plan = (src.to_df()
+            .group_by(F.pmod(col("k"), 5).alias("g"))
+            .agg(F.sum(col("v")).alias("s"), F.count().alias("c")))
+    return plan.write_stream(ck, output_mode="complete")
+
+
+def wait_commit(q, want, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while q._committed_batch < want and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert q._committed_batch >= want, (q._committed_batch, q.state())
+
+
+prod = FrameProducer()
+prod.start()
+ck = os.path.join(base, "ck")
+
+rc0 = spark.metrics.counter("streaming_reconnects").value
+q = build(prod, ck)
+q.start(trigger_ms=50)
+prod.send(round_df(0))
+wait_commit(q, 0)
+committed0 = q._committed_batch
+
+# mid-stream socket kill: the reconnect ladder re-establishes via the
+# durable-offset handshake; the next round commits with zero loss
+prod.kill_connection()
+prod.send(round_df(1))
+wait_commit(q, committed0 + 1)
+q.stop()
+assert q.status == "STOPPED", q.state()
+rec = spark.metrics.counter("streaming_reconnects").value - rc0
+assert rec == 1, f"expected exactly 1 reconnect, got {rec}"
+
+# injected fatal at the trigger seam parks the loop in FAILED
+with faults.inject(spark.conf, "trigger_tick:fatal:1") as plan:
+    q.start(trigger_ms=50)
+    deadline = time.monotonic() + 30.0
+    while q.status == "RUNNING" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert plan.fired_log, "trigger_tick fault never fired"
+assert q.status == "FAILED", q.state()
+assert "FaultInjected" in (q.exception() or ""), q.exception()
+
+# hard crash (query object GONE), then a FRESH query over the same
+# checkpoint must recover byte-identical to an uninterrupted twin
+q.stream.close()
+del q
+prod.send(round_df(2))
+q2 = build(prod, ck)
+q2.process_available()
+got = q2.latest().sort_values("g").reset_index(drop=True)
+
+twin = FrameProducer()
+twin.start()
+q3 = build(twin, os.path.join(base, "ck_twin"))
+for i in range(3):
+    twin.send(round_df(i))
+q3.process_available()
+want = q3.latest().sort_values("g").reset_index(drop=True)
+pd.testing.assert_frame_equal(got, want)
+
+q2.stream.close()
+q3.stream.close()
+LockWatch().assert_no_thread_leak("spark-tpu-stream-trigger")
+prod.close()
+twin.close()
+print(json.dumps({
+    "preflight_unattended_streaming_smoke": "ok",
+    "reconnects": int(rec),
+    "committed_batches": int(q2._committed_batch + 1),
+    "groups": int(len(got))}))
+EOF14
 
 echo "== preflight PASSED =="

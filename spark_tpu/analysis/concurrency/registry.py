@@ -108,12 +108,24 @@ LOCKS: Tuple[LockDecl, ...] = (
     LockDecl("service.install", _SVC + "server.py", "SqlService",
              "_install_lock", "lock", 24,
              "one-shot arbiter installation guard"),
+    LockDecl("streaming.live", "spark_tpu/streaming.py", "",
+             "_LIVE_LOCK", "lock", 25,
+             "live trigger-loop registry (stream-<n> -> query): "
+             "registered in start(), dropped by the loop's finally / "
+             "stop(); dict ops only inside — per-query status rows "
+             "build OUTSIDE it"),
     LockDecl("execution.lifecycle", "spark_tpu/execution/lifecycle.py",
              "", "_TOKENS_LOCK", "lock", 26,
              "cancel-token registry ((app_id, query_id) -> token): "
              "registered by the executor under the session lease, "
              "cancelled from any thread; dict ops only inside — "
              "token.cancel() (an Event.set) runs outside it"),
+    LockDecl("streaming.trigger", "spark_tpu/streaming.py",
+             "_TriggerStatus", "_lock", "lock", 27,
+             "cross-thread status slice of a supervised streaming "
+             "query (loop thread writes, service/stop() read); field "
+             "ops only inside — seams, metrics and listener posts all "
+             "fire OUTSIDE it"),
     LockDecl("service.arbiter", _SVC + "arbiter.py",
              "DeviceResourceArbiter", "_cv", "condition", 30,
              "HBM lease pool (cv: denied leases wait for releases)"),
@@ -258,6 +270,23 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
     # lifecycle token registry (module-level global)
     GuardDecl("spark_tpu/execution/lifecycle.py", "", "_TOKENS",
               "_TOKENS_LOCK"),
+    # streaming live registry (module-level globals) + trigger status
+    GuardDecl("spark_tpu/streaming.py", "", "_LIVE", "_LIVE_LOCK"),
+    GuardDecl("spark_tpu/streaming.py", "", "_LIVE_SEQ", "_LIVE_LOCK"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus", "status",
+              "_lock"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus", "error",
+              "_lock"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus", "ticks",
+              "_lock"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus",
+              "skipped_ticks", "_lock"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus", "restarts",
+              "_lock"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus",
+              "last_skew_ms", "_lock"),
+    GuardDecl("spark_tpu/streaming.py", "_TriggerStatus", "trigger_ms",
+              "_lock"),
 )
 
 #: intentionally-unguarded state, each with the reason the race is

@@ -362,6 +362,20 @@ STRAGGLER_REBALANCE_MAX_SKEW = register(
     validator=lambda v: 0.0 <= v < 1.0,
     type_=float)
 
+STRAGGLER_REBALANCE_DECAY_CHUNKS = register(
+    "spark_tpu.sql.straggler.rebalance.decayChunks", 0,
+    doc="Straggler rebalance weight DECAY: a flagged shard's skew "
+        "penalty fades linearly back to zero over this many healthy "
+        "chunks after the flag, so a recovered shard earns its fair "
+        "row share back instead of staying penalized for the rest of "
+        "the stream. Chunk-shape capacity stays sized for the "
+        "full-penalty trajectory (static shapes never re-specialize "
+        "mid-decay); when every penalty reaches zero the zero-cost "
+        "unflagged path resumes. A re-flag mid-decay resets that "
+        "shard's penalty to full. 0 keeps the legacy behavior "
+        "(penalized until the stream ends).",
+    validator=lambda v: v >= 0)
+
 MESH_FALLBACK_ENABLED = register(
     "spark_tpu.execution.meshFallback.enabled", True,
     doc="When a distributed run fails inside the mesh/collective path "
@@ -827,6 +841,85 @@ STREAMING_FILE_STRICT = register(
         "cannot wedge the stream. true fails the batch instead "
         "(at-least-once delivery of every file byte wins over "
         "availability).")
+
+STREAMING_NET_MAX_RECONNECTS = register(
+    "spark_tpu.streaming.source.network.maxReconnects", 8,
+    doc="Network stream source (io/network_source.py) reconnect "
+        "ladder: maximum reconnect attempts per poll after the peer "
+        "dies mid-stream (EOF, reset, or a mid-frame stall), under "
+        "exponential backoff + jitter (failures.RetryPolicy over "
+        "source.network.backoffMs). Every successful reconnect "
+        "handshakes the durable frame offset back to the producer, so "
+        "the stream resumes with zero loss and zero duplication. "
+        "Exhausting the ladder fails the poll with a TRANSIENT "
+        "connection error for the trigger supervisor to classify.",
+    validator=lambda v: v >= 0)
+
+STREAMING_NET_CONNECT_TIMEOUT_MS = register(
+    "spark_tpu.streaming.source.network.connectTimeoutMs", 2000,
+    doc="Network stream source: milliseconds each socket connect "
+        "attempt may take before counting as a failed "
+        "reconnect-ladder rung.",
+    validator=lambda v: v >= 1)
+
+STREAMING_NET_IDLE_TIMEOUT_MS = register(
+    "spark_tpu.streaming.source.network.idleTimeoutMs", 50,
+    doc="Network stream source idle/stall discriminator: a read that "
+        "times out while waiting for the FIRST byte of a new frame "
+        "means a quiet producer — the poll returns the offsets drained "
+        "so far and keeps the connection. The same timeout landing "
+        "MID-frame (header or payload partially read) means a dead or "
+        "wedged peer and takes the reconnect ladder instead.",
+    validator=lambda v: v >= 1)
+
+STREAMING_NET_BACKOFF_MS = register(
+    "spark_tpu.streaming.source.network.backoffMs", 50,
+    doc="Network stream source: base backoff milliseconds for the "
+        "reconnect ladder; attempt k sleeps backoffMs * 2^k with "
+        "+/-50% jitter on the interruptible lifecycle wait.",
+    validator=lambda v: v >= 0)
+
+STREAMING_TRIGGER_MAX_RESTARTS = register(
+    "spark_tpu.streaming.trigger.maxRestarts", 3,
+    doc="Supervised trigger loop (StreamingQuery.start): how many "
+        "times a TRANSIENT batch failure may restart within one "
+        "failure streak before the query parks in FAILED status. The "
+        "streak resets after any successful tick; FATAL failures park "
+        "immediately without consuming restarts.",
+    validator=lambda v: v >= 0)
+
+STREAMING_TRIGGER_BACKOFF_MS = register(
+    "spark_tpu.streaming.trigger.backoffMs", 100,
+    doc="Supervised trigger loop: base backoff milliseconds between "
+        "TRANSIENT-failure restarts (exponential + jitter via "
+        "failures.RetryPolicy, slept on the interruptible lifecycle "
+        "wait so stop()/cancel interrupts a parked backoff "
+        "immediately).",
+    validator=lambda v: v >= 0)
+
+STREAMING_STATE_SPILL_BYTES = register(
+    "spark_tpu.streaming.state.spillBytes", 0,
+    doc="Host-spill threshold for event-time streaming-aggregate "
+        "state: when the committed keyed state exceeds this many "
+        "bytes it stops being held resident between triggers and "
+        "reroutes through the external keyed backend "
+        "(execution/external.py SpillableKeyedState) — hash-"
+        "partitioned parquet spill files under the query checkpoint; "
+        "each trigger's MERGE touches only the partitions its batch's "
+        "keys hash to, and only the touched partitions rewrite at "
+        "adoption. Persistence is unchanged (the same delta/snapshot "
+        "store commits the same full frames), so crash recovery is "
+        "identical; spilled bytes count in streaming_spill_bytes. "
+        "0 disables spill (state stays resident).",
+    validator=lambda v: v >= 0)
+
+STREAMING_STATE_SPILL_PARTITIONS = register(
+    "spark_tpu.streaming.state.spillPartitions", 16,
+    doc="Partition count for the host-spill keyed state backend: "
+        "state rows hash-route by key to this many parquet spill "
+        "files; a trigger rewrites only the partitions its batch's "
+        "keys (or evicted windows) touch.",
+    validator=lambda v: v >= 1)
 
 COMPILE_CACHE_ENABLED = register(
     "spark_tpu.sql.compileCache.enabled", False,

@@ -42,7 +42,7 @@ def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
 #: event fields kept nested (object columns) rather than flattened
 _NESTED = ("spans", "stages", "shards", "predictions",
            "analysis_findings", "plan_tree", "reorder", "streaming",
-           "udf")
+           "udf", "trigger")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
@@ -216,31 +216,44 @@ def streaming_summary(events: pd.DataFrame) -> pd.DataFrame:
     """Per-micro-batch lifecycle from a read_event_log frame: one row
     per `streaming` record (schema v4) — batch id, offset range, rows
     in/out, state persistence kind (delta vs snapshot) and bytes,
-    changed groups, quarantined files, sink parts and wall time. The
-    replay surface of the durable-streaming tier (streaming.py +
-    execution/state_store.py); the incremental-checkpointing claim
-    (steady-state delta bytes << snapshot bytes) is checkable straight
-    off this frame."""
+    changed groups, quarantined files, sink parts and wall time — and
+    one row per `trigger` record (schema v6, record='trigger') — tick
+    id, wall-clock skew, batches run, supervisor restarts and
+    reconnects. The replay surface of the durable-streaming tier
+    (streaming.py + execution/state_store.py); the incremental-
+    checkpointing claim (steady-state delta bytes << snapshot bytes)
+    and the unattended-operation story (reconnects, restarts, skew)
+    are both checkable straight off this frame."""
     rows: List[dict] = []
-    if "streaming" not in events.columns:
-        return pd.DataFrame(rows)
     for _, r in events.iterrows():
-        s = r.get("streaming")
-        if not isinstance(s, dict):
-            continue
-        rows.append({"ts": r.get("ts"), "app": r.get("app"),
-                     "query_id": r.get("query_id"),
-                     "batch_id": s.get("batch_id"),
-                     "start": s.get("start"), "end": s.get("end"),
-                     "rows_in": s.get("rows_in"),
-                     "rows_out": s.get("rows_out"),
-                     "kind": s.get("kind"),
-                     "state_bytes": s.get("state_bytes"),
-                     "changed_groups": s.get("changed_groups"),
-                     "quarantined": s.get("quarantined"),
-                     "sink_parts": s.get("sink_parts"),
-                     "source": s.get("source"),
-                     "wall_ms": s.get("wall_ms")})
+        s = r.get("streaming") \
+            if "streaming" in events.columns else None
+        if isinstance(s, dict):
+            rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                         "query_id": r.get("query_id"),
+                         "record": "batch",
+                         "batch_id": s.get("batch_id"),
+                         "start": s.get("start"), "end": s.get("end"),
+                         "rows_in": s.get("rows_in"),
+                         "rows_out": s.get("rows_out"),
+                         "kind": s.get("kind"),
+                         "state_bytes": s.get("state_bytes"),
+                         "changed_groups": s.get("changed_groups"),
+                         "quarantined": s.get("quarantined"),
+                         "sink_parts": s.get("sink_parts"),
+                         "source": s.get("source"),
+                         "wall_ms": s.get("wall_ms")})
+        t = r.get("trigger") if "trigger" in events.columns else None
+        if isinstance(t, dict):
+            rows.append({"ts": r.get("ts"), "app": r.get("app"),
+                         "query_id": r.get("query_id"),
+                         "record": "trigger",
+                         "tick": t.get("tick"),
+                         "skew_ms": t.get("skew_ms"),
+                         "batches_run": t.get("batches_run"),
+                         "restarts": t.get("restarts"),
+                         "reconnects": t.get("reconnects"),
+                         "source": t.get("source")})
     return pd.DataFrame(rows)
 
 

@@ -140,6 +140,21 @@ class StreamingBatchEvent:
 
 
 @dataclass
+class StreamingTriggerEvent:
+    """Posted by the supervised trigger loop (streaming.py): one per
+    tick that ran batches, plus the parking tick of a FAILED query.
+    `record` is the event-log `trigger` record — tick id, wall-clock
+    skew, batches run, supervisor restarts, source kind, reconnects.
+    The event-log listener writes it as its own (schema v6, additive)
+    line; `history.streaming_summary` folds it in."""
+
+    query_id: int
+    ts: float
+    plan: str
+    record: Dict = field(default_factory=dict)
+
+
+@dataclass
 class QueryEndEvent:
     """Posted when an execution finishes (status 'ok') or fails past
     recovery (status 'error'). `event` is the full event-log record —
@@ -156,7 +171,7 @@ class QueryEndEvent:
 CALLBACKS = ("on_query_start", "on_analysis", "on_stage_compiled",
              "on_stage_completed", "on_fault", "on_query_end",
              "on_service", "on_shard_records", "on_straggler",
-             "on_streaming_batch")
+             "on_streaming_batch", "on_streaming_trigger")
 
 
 class QueryListener:
@@ -196,6 +211,10 @@ class QueryListener:
         pass
 
     def on_streaming_batch(self, event: StreamingBatchEvent) -> None:
+        pass
+
+    def on_streaming_trigger(self,
+                             event: StreamingTriggerEvent) -> None:
         pass
 
 

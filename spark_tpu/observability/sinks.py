@@ -14,7 +14,8 @@ import os
 import re
 import warnings
 
-from .listener import QueryEndEvent, QueryListener, StreamingBatchEvent
+from .listener import (QueryEndEvent, QueryListener,
+                       StreamingBatchEvent, StreamingTriggerEvent)
 from .spans import to_chrome_trace
 
 # v3: per-shard telemetry (`shards` records + `shards_dropped`), the
@@ -22,10 +23,12 @@ from .spans import to_chrome_trace
 # self-grading). v4: the per-batch `streaming` record (micro-batch
 # lifecycle: offsets, delta-vs-snapshot state bytes, quarantines).
 # v5: the per-query `udf` record (lane mode, Arrow batch/row totals,
-# exec ms, worker restarts). Purely additive — older logs replay
-# unchanged (scripts/events_tool.py validates every published
-# version).
-EVENT_LOG_SCHEMA_VERSION = 5
+# exec ms, worker restarts). v6: the per-tick `trigger` record from
+# the supervised streaming trigger loop (tick id, skew, batches run,
+# supervisor restarts, source kind, reconnects). Purely additive —
+# older logs replay unchanged (scripts/events_tool.py validates every
+# published version).
+EVENT_LOG_SCHEMA_VERSION = 6
 
 
 def json_default(o):
@@ -131,6 +134,25 @@ class EventLogListener(QueryListener):
             query_id=event.query_id, ts=event.ts, status="ok",
             event=line_event))
 
+    def on_streaming_trigger(self,
+                             event: StreamingTriggerEvent) -> None:
+        """One (schema v6) line per trigger-loop tick that ran
+        batches (plus the parking tick of a FAILED query): the
+        `trigger` record — unattended-operation lifecycle next to the
+        per-batch `streaming` lines."""
+        log_dir = str(self._session.conf.get(self.DIR_KEY))
+        if not log_dir:
+            return
+        line_event = {
+            "ts": event.ts, "query_id": event.query_id, "status": "ok",
+            "plan": event.plan,
+            "schema_version": EVENT_LOG_SCHEMA_VERSION,
+            "trigger": event.record,
+        }
+        self.on_query_end(QueryEndEvent(
+            query_id=event.query_id, ts=event.ts, status="ok",
+            event=line_event))
+
 
 class ChromeTraceListener(QueryListener):
     """Writes `<trace.dir>/query-<app_id>-<id>.trace.json` per
@@ -225,6 +247,12 @@ class MetricsSinkListener(QueryListener):
         # (StreamingQuery / StateStore); per-batch flush keeps the
         # exposition file current for long-running streams that never
         # execute a regular (query-end-posting) batch query
+        self._session.metrics.flush(self._session.conf)
+
+    def on_streaming_trigger(self,
+                             event: StreamingTriggerEvent) -> None:
+        # same rationale: an unattended stream's reconnect/spill
+        # counters must reach the exposition file between query ends
         self._session.metrics.flush(self._session.conf)
 
 

@@ -58,7 +58,7 @@ from __future__ import annotations
 import contextlib
 import warnings
 from contextvars import ContextVar
-from typing import Optional, Sequence, Set, Tuple
+from typing import Dict, Optional, Sequence, Set, Tuple
 
 from ..observability.listener import QueryListener
 
@@ -68,6 +68,7 @@ DECOMMISSION_KEY = "spark_tpu.execution.decommission.shards"
 EXCLUDE_KEY = "spark_tpu.sql.mesh.excludeDevices"
 REBALANCE_ENABLED_KEY = "spark_tpu.sql.straggler.rebalance.enabled"
 REBALANCE_MAX_SKEW_KEY = "spark_tpu.sql.straggler.rebalance.maxSkew"
+REBALANCE_DECAY_KEY = "spark_tpu.sql.straggler.rebalance.decayChunks"
 BACKOFF_KEY = "spark_tpu.execution.backoffMs"
 
 
@@ -285,9 +286,15 @@ class RebalanceState:
     zero-cost `pad_batch_to_multiple` path. After `flag(shard)`, each
     chunk's live rows are re-assigned: the flagged shard's share drops
     to (1 - maxSkew) x fair, the deficit spreads evenly over healthy
-    shards. Per-shard slot capacity is uniform (and constant while the
-    flag set is stable), so the jitted update step re-specializes at
-    most once per flag. Partial aggregation does not depend on which
+    shards. With `straggler.rebalance.decayChunks` > 0 the penalty is
+    not a life sentence: each rebalanced chunk fades every flagged
+    shard's penalty linearly by 1/decayChunks, so a recovered shard
+    earns its fair share back over that many healthy chunks and the
+    state goes inert again (shares return to uniform; 0 keeps the
+    legacy stay-flagged-forever behavior). Per-shard slot capacity is
+    uniform and sized from the FULL-penalty trajectory (not the
+    decayed weights), so shapes stay stable across the whole decay
+    and the jitted update step re-specializes at most once per flag. Partial aggregation does not depend on which
     shard folds which row — integer/decimal results are bit-exact;
     float sums can move in the last ulp (summation order), as with
     any mesh-size or chunk-boundary change."""
@@ -297,7 +304,11 @@ class RebalanceState:
         self.enabled = bool(conf.get(REBALANCE_ENABLED_KEY))
         self.max_skew = float(conf.get(REBALANCE_MAX_SKEW_KEY))
         self.recovery = recovery  # RecoveryContext: record() + metrics
+        self.decay_chunks = int(conf.get(REBALANCE_DECAY_KEY))
         self.slow: Set[int] = set()
+        #: shard -> remaining penalty in (0, 1]; 1.0 at flag time,
+        #: fading by 1/decayChunks per rebalanced chunk (tick())
+        self.penalty: Dict[int, float] = {}
         self.moved_rows = 0
 
     @property
@@ -311,26 +322,39 @@ class RebalanceState:
         shard = int(shard)
         if not self.enabled or self.max_skew <= 0:
             return
-        if shard in self.slow or not 0 <= shard < self.n:
+        if shard in self.slow:
+            self.penalty[shard] = 1.0  # re-flag mid-decay: full again
+            return
+        if not 0 <= shard < self.n:
             return
         if len(self.slow) >= self.n - 1:
             return  # at least one healthy shard must absorb the skew
         self.slow.add(shard)
+        self.penalty[shard] = 1.0
         if self.recovery is not None:
             self.recovery.record("shard_rebalance", None, shard=shard,
                                  max_skew=self.max_skew)
 
     # -- assignment math ----------------------------------------------------
 
-    def _weights(self):
+    def _weights(self, decayed: bool = True):
+        """Per-shard assignment weights. `decayed=True` scales each
+        flagged shard's skew by its remaining penalty (the live
+        assignment); `decayed=False` is the full-penalty trajectory
+        slot_capacity sizes shapes from, stable across a decay."""
         import numpy as np
         w = np.ones(self.n)
         z = len(self.slow)
         if z and z < self.n:
-            boost = self.max_skew * z / (self.n - z)
+            deficit = 0.0
+            for i in self.slow:
+                p = self.penalty.get(i, 1.0) if decayed else 1.0
+                w[i] = 1.0 - self.max_skew * p
+                deficit += self.max_skew * p
+            boost = deficit / (self.n - z)
             for i in range(self.n):
-                w[i] = (1.0 - self.max_skew) if i in self.slow \
-                    else 1.0 + boost
+                if i not in self.slow:
+                    w[i] = 1.0 + boost
         return w
 
     def targets(self, live: int):
@@ -348,7 +372,7 @@ class RebalanceState:
         of a fully-live chunk (+1 rounding margin), constant while the
         flag set is stable so shapes stay stable."""
         import numpy as np
-        wmax = float(np.max(self._weights()))
+        wmax = float(np.max(self._weights(decayed=False)))
         return int(-(-int(chunk_capacity) * wmax // self.n)) + 1
 
     def rebalance(self, batch, n: int):
@@ -382,6 +406,7 @@ class RebalanceState:
         if self.recovery is not None and self.recovery.metrics is not None \
                 and moved:
             self.recovery.metrics.counter("rebalance_rows").inc(moved)
+        self.tick()
         take_d = jnp.asarray(take)
         cols = {}
         for name, c in batch.columns.items():
@@ -390,6 +415,23 @@ class RebalanceState:
                 else jnp.take(c.validity, take_d, axis=0)
             cols[name] = Column(data, c.dtype, validity, c.dictionary)
         return Batch(cols, jnp.asarray(sel))
+
+    def tick(self) -> None:
+        """One rebalanced chunk elapsed: fade every flagged shard's
+        penalty by 1/decayChunks; a shard whose penalty reaches zero
+        unflags — when the last one does, `active` goes False and
+        padding returns to the zero-cost path (shares uniform
+        again)."""
+        if self.decay_chunks <= 0:
+            return
+        step = 1.0 / self.decay_chunks
+        for shard in sorted(self.slow):
+            p = self.penalty.get(shard, 1.0) - step
+            if p > 1e-12:
+                self.penalty[shard] = p
+            else:
+                self.slow.discard(shard)
+                self.penalty.pop(shard, None)
 
 
 def pad_chunk_for_shards(batch, n: int,

@@ -599,7 +599,10 @@ class SqlService:
                       session: Optional[str] = None) -> Dict:
         """Paginated query listing, newest first, optionally filtered
         by status / session name. Bounded by the same queryLogSize
-        registry GET /queries/<id> reads from."""
+        registry GET /queries/<id> reads from. Live streaming trigger
+        loops (streaming.live_queries) ride along under `streams` —
+        unpaginated; there are at most a handful per process."""
+        from ..streaming import live_queries
         offset = max(0, int(offset))
         limit = max(1, min(int(limit), 500))
         with self._records_lock:
@@ -614,7 +617,11 @@ class SqlService:
         page = records[offset:offset + limit]
         out = {"queries": [{k: r.get(k) for k in self._LIST_FIELDS
                             if k in r} for r in page],
-               "total": len(records), "offset": offset, "limit": limit}
+               "total": len(records), "offset": offset, "limit": limit,
+               # outside _records_lock by construction (this line runs
+               # after the with block): live_queries takes its own
+               # registry + per-query status locks
+               "streams": live_queries()}
         if offset + limit < len(records):
             out["next_offset"] = offset + limit
         return out
@@ -672,7 +679,23 @@ class SqlService:
         json_body) — 200 cancel_requested, 404 unknown id (structured,
         same error shape as 429/503), 409 already finished.
         Idempotent: a second DELETE of a still-stopping query returns
-        another 200; cancel-after-finish is the 409."""
+        another 200; cancel-after-finish is the 409.
+
+        `stream-<n>` ids are live streaming trigger loops
+        (streaming.live_queries): DELETE stops the loop — cancel the
+        lifecycle token, join the thread bounded — leaving zero orphan
+        threads and the checkpoint at its last committed batch."""
+        if query_id.startswith("stream-"):
+            from ..streaming import get_live
+            q = get_live(query_id)
+            if q is None:
+                return 404, {"error": "NOT_FOUND",
+                             "message": f"no live streaming query "
+                                        f"{query_id!r}",
+                             "query_id": query_id}
+            q.stop()
+            return 200, {"query_id": query_id, "status": "stopped",
+                         "query_status": q.status}
         rec = self.get_query(query_id)
         if rec is None:
             return 404, {"error": "NOT_FOUND",

@@ -308,6 +308,17 @@ class SparkTpuSession(metaclass=_ActiveSessionMeta):
         return FileStreamSource(self, path, schema_df=schema_df,
                                 format=format)
 
+    def network_stream(self, host: str, port: int, schema_df):
+        """Socket streaming source (io/network_source.py): length-
+        framed Arrow-IPC record batches over TCP, each frame persisted
+        under the query's checkpoint BEFORE it becomes a visible
+        offset, with a reconnect/backoff ladder (see the
+        spark_tpu.streaming.source.network.* keys). Returns a
+        NetworkStreamSource whose `.to_df()` feeds
+        `DataFrame.write_stream`."""
+        from .io.network_source import NetworkStreamSource
+        return NetworkStreamSource(self, host, port, schema_df)
+
     def long_accumulator(self, name: str = "acc") -> "Accumulator":
         return Accumulator(name, 0)
 

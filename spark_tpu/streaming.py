@@ -32,6 +32,27 @@ fire before their boundary's action; the durability chaos matrix
 proves a fresh query over the same checkpoint loses and duplicates
 nothing.
 
+Unattended operation: `query.start(trigger_ms=...)` runs the same
+loop on a supervised daemon thread — a wall-clock trigger that
+classifies batch failures through the execution/failures.py taxonomy
+(TRANSIENT ticks retry under the bounded RetryPolicy backoff ladder;
+FATAL errors park the query in a structured FAILED status instead of
+wedging or dying silently), paces with skip-don't-queue overrun
+semantics, and is cancellable/deadline-capped through the
+execution/lifecycle.py token (`stop()` joins the thread bounded; the
+SQL service lists live streams under GET /queries and DELETE stops
+them). The socket source (io/network_source.py) extends exactly-once
+over a network hop: frames are persisted before they become visible
+as offsets, so the reconnect ladder replays nothing and loses
+nothing. Event-time keyed state larger than
+`spark_tpu.streaming.state.spillBytes` reroutes residency through the
+hash-partitioned host backend (execution/external.py:
+SpillableKeyedState); the persisted deltas/snapshots are identical,
+so crash recovery is unchanged. The unattended seams —
+`stream_net_connect`, `stream_net_recv`, `trigger_tick`,
+`state_spill` — get their own chaos matrix in
+tests/test_streaming_unattended.py.
+
 Sources: `MemoryStream` (the deterministic test source) and
 `FileStreamSource` (directory tailing with a persisted seen-file log;
 corrupt files quarantine instead of wedging the stream). Sink:
@@ -50,7 +71,9 @@ per-trigger durability cost used to be O(state) too.
 from __future__ import annotations
 
 import json
+import math
 import os
+import threading
 import time
 import warnings
 from typing import Dict, List, Optional
@@ -67,6 +90,10 @@ from .plan import logical as L
 
 FILE_STRICT_KEY = "spark_tpu.streaming.source.file.strict"
 RETAIN_KEY = "spark_tpu.streaming.retainBatches"
+TRIGGER_MAX_RESTARTS_KEY = "spark_tpu.streaming.trigger.maxRestarts"
+TRIGGER_BACKOFF_KEY = "spark_tpu.streaming.trigger.backoffMs"
+SPILL_BYTES_KEY = "spark_tpu.streaming.state.spillBytes"
+SPILL_PARTS_KEY = "spark_tpu.streaming.state.spillPartitions"
 
 
 class _MetadataLog:
@@ -406,10 +433,112 @@ def read_sink(path: str) -> pd.DataFrame:
     return FileStreamSink.read(path)
 
 
+# ---------------------------------------------------------------------------
+# Live-query registry + supervised trigger status
+# ---------------------------------------------------------------------------
+
+#: queries with a RUNNING trigger loop, keyed by the "stream-<n>" live
+#: id the SQL service exposes (GET /queries folds these rows in;
+#: DELETE /queries/stream-<n> stops the loop). Registered in start(),
+#: unregistered by the loop's finally (and again, idempotently, by
+#: stop()). Lock: analysis/concurrency/registry.py `streaming.live`.
+_LIVE_LOCK = threading.Lock()
+_LIVE: Dict[str, "StreamingQuery"] = {}
+_LIVE_SEQ = 0
+
+
+def _register_live(q: "StreamingQuery") -> str:
+    global _LIVE_SEQ
+    with _LIVE_LOCK:
+        _LIVE_SEQ += 1
+        live_id = f"stream-{_LIVE_SEQ}"
+        _LIVE[live_id] = q
+    return live_id
+
+
+def _unregister_live(live_id: Optional[str]) -> None:
+    if live_id is None:
+        return
+    with _LIVE_LOCK:
+        _LIVE.pop(live_id, None)
+
+
+def get_live(live_id: str) -> Optional["StreamingQuery"]:
+    with _LIVE_LOCK:
+        return _LIVE.get(live_id)
+
+
+def live_queries() -> List[dict]:
+    """Status rows for every live trigger loop. Snapshot the registry
+    under its lock, build the rows OUTSIDE it: each row takes that
+    query's _TriggerStatus lock, and the two locks are never held
+    together (registry rank 25 < trigger rank 27 would allow it, but
+    one-at-a-time needs no edge)."""
+    with _LIVE_LOCK:
+        items = sorted(_LIVE.items())
+    return [dict(q.state(), id=live_id) for live_id, q in items]
+
+
+class _TriggerStatus:
+    """The CROSS-THREAD slice of a supervised streaming query: the
+    trigger-loop thread writes it; `status`/`state()`, the service
+    listing and `stop()` read it. Kept in its own tiny class so the
+    concurrency lint audits exactly these fields — everything else on
+    StreamingQuery stays confined to whichever thread currently
+    drives the loop (start() hands the whole object to the trigger
+    thread; the manual-trigger path never starts one)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.status = "INITIALIZED"
+        self.error: Optional[str] = None
+        self.ticks = 0
+        self.skipped_ticks = 0
+        self.restarts = 0
+        self.last_skew_ms = 0.0
+        self.trigger_ms: Optional[float] = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"status": self.status, "error": self.error,
+                    "ticks": self.ticks,
+                    "skipped_ticks": self.skipped_ticks,
+                    "restarts": self.restarts,
+                    "last_skew_ms": self.last_skew_ms,
+                    "trigger_ms": self.trigger_ms}
+
+    def set_running(self, trigger_ms: float) -> None:
+        with self._lock:
+            self.status = "RUNNING"
+            self.error = None
+            self.trigger_ms = float(trigger_ms)
+
+    def finish(self, status: str, error: Optional[str]) -> None:
+        with self._lock:
+            self.status = status
+            self.error = error
+
+    def tick(self, skew_ms: float) -> int:
+        with self._lock:
+            self.ticks += 1
+            self.last_skew_ms = float(skew_ms)
+            return self.ticks
+
+    def skip(self, n: int) -> None:
+        with self._lock:
+            self.skipped_ticks += int(n)
+
+    def restart(self) -> int:
+        with self._lock:
+            self.restarts += 1
+            return self.restarts
+
+
 class StreamingQuery:
     """One micro-batch query (reference: StreamExecution). Trigger is
     manual (`process_available()`) — the deterministic single-step mode
-    StreamTest uses; a wall-clock trigger is a loop around it."""
+    StreamTest uses — or the supervised wall-clock loop behind
+    `start(trigger_ms=...)`."""
 
     def __init__(self, session, plan: L.LogicalPlan, stream,
                  checkpoint_dir: str, output_mode: str = "complete",
@@ -458,6 +587,15 @@ class StreamingQuery:
         # event-time path: host state table + watermark (us)
         self._evstate: Optional[pd.DataFrame] = None
         self._wm: int = -(1 << 62)
+        # host-spillable keyed state (engages lazily when the resident
+        # event-time frame exceeds streaming.state.spillBytes)
+        self._spill = None
+        self._spill_dir = os.path.join(checkpoint_dir, "state", "spill")
+        # supervised trigger loop (start()/stop())
+        self._trigger = _TriggerStatus()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._token = None
+        self._live_id: Optional[str] = None
         self._recover()
 
     # -- plan shape ---------------------------------------------------------
@@ -567,7 +705,15 @@ class StreamingQuery:
             self._tables = p["tables"]
             self._flat = p["flat"]
         if "evstate" in p:
-            self._evstate = p["evstate"]
+            if p.get("spilled"):
+                # the spill partitions only move AFTER the commit-log
+                # write, and only the partitions this batch touched; a
+                # no-change batch rewrites nothing
+                if p.get("touched"):
+                    self._spill.adopt(p["evstate"], p["touched"])
+                self._evstate = None
+            else:
+                self._evstate = p["evstate"]
             self._wm = p["wm"]
 
     # -- event-time (watermark) path ----------------------------------------
@@ -656,12 +802,41 @@ class StreamingQuery:
             out[a.out_name] = vals.to_numpy()
         return pd.DataFrame(out)
 
+    def _maybe_engage_spill(self) -> None:
+        """Reroute event-time state residency through the host spill
+        backend once the resident frame exceeds its byte budget
+        (streaming.state.spillBytes; 0 = never). The persisted
+        deltas/snapshots are identical either way, so crash recovery
+        never notices — after a restart the store hands back a
+        resident frame and the very next trigger re-engages here."""
+        budget = int(self.session.conf.get(SPILL_BYTES_KEY))
+        if not budget or self._spill is not None \
+                or self._evstate is None:
+            return
+        if self._frame_bytes(self._evstate) <= budget:
+            return
+        from .execution.external import SpillableKeyedState
+        self._spill = SpillableKeyedState(
+            self._spill_dir, self._ev_group_cols,
+            int(self.session.conf.get(SPILL_PARTS_KEY)),
+            metrics=self.session.metrics)
+        self._spill.reset(self._evstate)
+        self._evstate = None
+
+    @staticmethod
+    def _frame_bytes(pdf: pd.DataFrame) -> int:
+        return int(pdf.memory_usage(index=False, deep=True).sum())
+
     def _run_batch_event(self, batch_id: int, table: pa.Table):
         import pyarrow.compute as pc
         self._ensure_event_prep()
+        self._maybe_engage_spill()
+        spilled = self._spill is not None
+        state0 = self._spill.materialize() if spilled else self._evstate
         col, delay = self._watermark
         wm = self._wm
-        new_state = self._evstate
+        new_state = state0
+        touched: List[int] = []
         batch_max = None
         if table.num_rows:
             ts = table.column(col)
@@ -684,7 +859,11 @@ class StreamingQuery:
                     partial_pdf[wcol] = pd.to_datetime(
                         partial_pdf[wcol]).astype("datetime64[us]") \
                         .astype("int64")
-            new_state = self._event_merge(new_state, partial_pdf)
+            if spilled:
+                new_state, touched = self._spill.merge(
+                    partial_pdf, self._event_merge)
+            else:
+                new_state = self._event_merge(new_state, partial_pdf)
         if batch_max is not None:
             wm = max(wm, batch_max - delay)
 
@@ -696,14 +875,20 @@ class StreamingQuery:
             if closed.any():
                 emitted = new_state[closed]
                 new_state = new_state[~closed].reset_index(drop=True)
+                if spilled:
+                    # evicted groups SHRANK their partitions: those
+                    # must rewrite at adoption too
+                    touched = sorted(
+                        set(touched) | set(
+                            self._spill.touched_by(emitted)))
 
         # persist BEFORE emitting/adopting (exactly-once on replay):
         # the store diffs against the COMMITTED state and writes a
         # changed-rows delta (or a snapshot on the cadence)
-        info = self._store.commit_frame(batch_id, new_state,
-                                        self._evstate,
+        info = self._store.commit_frame(batch_id, new_state, state0,
                                         self._ev_group_cols)
-        self._pending = {"evstate": new_state, "wm": wm}
+        self._pending = {"evstate": new_state, "wm": wm,
+                         "spilled": spilled, "touched": touched}
 
         out = None
         if self.output_mode == "complete":
@@ -1061,5 +1246,200 @@ class StreamingQuery:
         return [self._sink_results[k]
                 for k in sorted(self._sink_results)]
 
-    def stop(self) -> None:
-        pass  # manual trigger: nothing running between calls
+    # -- supervised trigger loop --------------------------------------------
+
+    @property
+    def status(self) -> str:
+        """INITIALIZED | RUNNING | STOPPED | FAILED."""
+        return self._trigger.snapshot()["status"]
+
+    def exception(self) -> Optional[str]:
+        """The parking error of a FAILED query (None otherwise)."""
+        return self._trigger.snapshot()["error"]
+
+    def state(self) -> dict:
+        """Structured status — the GET /queries row for live streams:
+        trigger counters plus the committed frontier."""
+        s = self._trigger.snapshot()
+        s.update({
+            "shape": self._shape(),
+            "output_mode": self.output_mode,
+            "source": str(getattr(self.stream, "source_kind",
+                                  "memory")),
+            "committed_batch": int(self._committed_batch),
+        })
+        return s
+
+    def start(self, trigger_ms: float = 100.0, clock=None, sleep=None,
+              rng=None) -> "StreamingQuery":
+        """Run the micro-batch loop unattended: a daemon thread calls
+        `process_available()` every `trigger_ms` of wall clock under a
+        restart supervisor. TRANSIENT/TIMEOUT tick failures (the
+        execution/failures.py taxonomy — network resets classify
+        TRANSIENT) retry under one bounded RetryPolicy ladder
+        (trigger.{maxRestarts,backoffMs}); any successful tick resets
+        the streak; FATAL errors (and an exhausted ladder) park the
+        query in FAILED status with the error preserved. A tick slower
+        than the interval SKIPS the missed ticks — wall-clock pacing
+        never queues a backlog. The loop runs under a fresh lifecycle
+        token (deadline from execution.queryDeadlineMs when set):
+        `stop()`/DELETE cancels it, a deadline parks FAILED; either
+        way the durable state stays at the last committed batch, so a
+        restart resumes exactly-once.
+
+        `clock`/`sleep`/`rng` are test seams (injected monotonic
+        clock, pacing+backoff sleep, backoff jitter)."""
+        if self._loop_thread is not None \
+                and self._loop_thread.is_alive():
+            raise RuntimeError("trigger loop already running")
+        from .execution import lifecycle
+        deadline_ms = int(self.session.conf.get(lifecycle.DEADLINE_KEY))
+        self._token = lifecycle.CancelToken(
+            deadline_ms=deadline_ms if deadline_ms > 0 else None)
+        self._trigger.set_running(trigger_ms)
+        self._live_id = _register_live(self)
+        t = threading.Thread(
+            target=self._trigger_loop,
+            args=(float(trigger_ms) / 1e3, clock or time.monotonic,
+                  sleep, rng),
+            daemon=True,
+            name=f"spark-tpu-stream-trigger-{self._live_id}")
+        self._loop_thread = t
+        try:
+            t.start()
+        except BaseException:
+            # thread exhaustion: undo the registration or the service
+            # would list a stream nothing is running
+            self._trigger.finish("FAILED",
+                                 "trigger thread failed to start")
+            _unregister_live(self._live_id)
+            self._loop_thread = None
+            raise
+        return self
+
+    def _trigger_loop(self, trigger_s: float, clock, sleep_fn, rng):
+        from .execution import failures, lifecycle
+        from .testing import faults
+        ctx_token = lifecycle.install(self._token)
+        status, error = "STOPPED", None
+        policy = None
+        nominal = clock()  # when the CURRENT tick was scheduled
+        try:
+            try:
+                while True:
+                    skew_ms = max(0.0, (clock() - nominal) * 1e3)
+                    before = self._committed_batch
+                    rc0 = int(self.session.metrics.counter(
+                        "streaming_reconnects").value)
+                    try:
+                        faults.arm(self.session.conf)
+                        # chaos seam: a crash at the very top of a tick
+                        faults.fire("trigger_tick")
+                        lifecycle.checkpoint("trigger_tick")
+                        self.process_available()
+                    except (lifecycle.QueryCancelledError,
+                            lifecycle.QueryDeadlineError):
+                        raise  # the outer handlers own these
+                    except Exception as e:  # noqa: BLE001 — supervised
+                        kind = failures.classify(e)
+                        if kind in (failures.FailureClass.TRANSIENT,
+                                    failures.FailureClass.TIMEOUT):
+                            if policy is None:
+                                policy = failures.RetryPolicy(
+                                    int(self.session.conf.get(
+                                        TRIGGER_MAX_RESTARTS_KEY)),
+                                    int(self.session.conf.get(
+                                        TRIGGER_BACKOFF_KEY)),
+                                    sleep=sleep_fn, rng=rng)
+                            if policy.attempt_retry() is not None:
+                                self._trigger.restart()
+                                continue  # re-tick now, no pacing wait
+                        # FATAL (or ladder exhausted): park, visibly
+                        status = "FAILED"
+                        error = f"{type(e).__name__}: {e}"[:400]
+                        snap = self._trigger.snapshot()
+                        self._record_trigger(
+                            snap["ticks"] + 1, skew_ms,
+                            int(self._committed_batch - before),
+                            int(self.session.metrics.counter(
+                                "streaming_reconnects").value - rc0),
+                            restarts=snap["restarts"])
+                        return
+                    policy = None  # a clean tick resets the streak
+                    tick = self._trigger.tick(skew_ms)
+                    batches = int(self._committed_batch - before)
+                    if batches > 0:
+                        self._record_trigger(
+                            tick, skew_ms, batches,
+                            int(self.session.metrics.counter(
+                                "streaming_reconnects").value - rc0))
+                    # pacing: skip missed ticks, never queue them
+                    now = clock()
+                    k = max(1, int(math.floor((now - nominal)
+                                              / trigger_s)) + 1)
+                    if k > 1:
+                        self._trigger.skip(k - 1)
+                    nominal += k * trigger_s
+                    wait = nominal - now
+                    if wait > 0:
+                        if sleep_fn is not None:
+                            self._token.check("trigger_sleep")
+                            sleep_fn(wait)
+                        else:
+                            lifecycle.sleep(wait)  # interruptible
+            except lifecycle.QueryCancelledError:
+                status, error = "STOPPED", None
+            except lifecycle.QueryDeadlineError as e:
+                status, error = "FAILED", \
+                    f"{type(e).__name__}: {e}"[:400]
+        finally:
+            self._trigger.finish(status, error)
+            _unregister_live(self._live_id)
+            lifecycle.uninstall(ctx_token)
+
+    def _record_trigger(self, tick: int, skew_ms: float,
+                        batches_run: int, reconnects: int,
+                        restarts: Optional[int] = None) -> None:
+        """Post the schema-v6 `trigger` observability record (one per
+        tick that ran batches, plus the parking tick of a FAILED
+        query)."""
+        if restarts is None:
+            restarts = self._trigger.snapshot()["restarts"]
+        record = {
+            "tick": int(tick),
+            "skew_ms": round(float(skew_ms), 3),
+            "batches_run": int(batches_run),
+            "restarts": int(restarts),
+            "source": str(getattr(self.stream, "source_kind",
+                                  "memory")),
+            "reconnects": int(reconnects),
+        }
+        from .observability.listener import StreamingTriggerEvent
+        self.session.listeners.post(
+            "on_streaming_trigger",
+            StreamingTriggerEvent(
+                query_id=self.session._next_query_id(), ts=time.time(),
+                plan=f"StreamingQuery[{self._shape()},"
+                     f"{self.output_mode}]",
+                record=record))
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Stop the trigger loop (a no-op for manual-trigger queries):
+        cancel the lifecycle token — which interrupts a pacing or
+        backoff sleep immediately — and join the thread BOUNDED.
+        Idempotent. The durable state stays at the last committed
+        batch, so a later start() or a fresh query resumes
+        exactly-once."""
+        t, self._loop_thread = self._loop_thread, None
+        if t is None:
+            return
+        if self._token is not None:
+            self._token.cancel()
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            self._loop_thread = t  # keep it stoppable again
+            raise RuntimeError(
+                f"trigger loop failed to stop within {timeout_s}s")
+        # the loop's finally normally unregisters; stay safe against a
+        # thread that died before reaching it
+        _unregister_live(self._live_id)

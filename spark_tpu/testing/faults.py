@@ -19,7 +19,8 @@ Grammar (comma-separated rules):
              | decommission | stream_source_list
              | stream_offset_write | stream_state_commit
              | stream_sink_emit | compile_cache_load | cancel_point
-             | udf_batch | udf_worker_spawn
+             | udf_batch | udf_worker_spawn | stream_net_connect
+             | stream_net_recv | trigger_tick | state_spill
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
              | cancel
@@ -91,6 +92,23 @@ cancellation at exactly the nth boundary a query crosses: the
 cancel-point chaos matrix (tests/test_lifecycle.py) sweeps `n` across
 execution shapes to prove every boundary releases its resources.
 
+The unattended-streaming seams extend the micro-batch set to the
+network tier (io/network_source.py + streaming.py +
+execution/external.py): `stream_net_connect` fires before every socket
+connect ATTEMPT (first connect and every reconnect-ladder rung, so
+`nth` targets a specific rung), `stream_net_recv` before each frame
+read off the wire (nothing of that frame persisted yet — a `fatal`
+there models the consumer dying mid-stream, and the offset handshake
+on the next connect proves zero loss/zero duplication),
+`trigger_tick` at the top of every supervised trigger-loop tick
+(before the tick's `process_available`, so a crash there loses the
+whole tick and the restart supervisor classifies it), and
+`state_spill` before each spill-partition write in the host-spillable
+keyed state backend (the partition file is the action — nothing
+written yet when the rule fires). The unattended chaos matrix
+(tests/test_streaming_unattended.py) kills at each seam and proves a
+fresh query over the same checkpoint recovers byte-identically.
+
 `udf_batch` fires once per batch ATTEMPT inside the out-of-process UDF
 lane's per-slice retry loop (execution/python_eval.py worker mode —
 the seam sits inside the ChunkRetrier step, so replays re-fire). A
@@ -127,7 +145,9 @@ KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "decommission", "stream_source_list",
                "stream_offset_write", "stream_state_commit",
                "stream_sink_emit", "compile_cache_load",
-               "cancel_point", "udf_batch", "udf_worker_spawn")
+               "cancel_point", "udf_batch", "udf_worker_spawn",
+               "stream_net_connect", "stream_net_recv",
+               "trigger_tick", "state_spill")
 
 #: sites that fire INSIDE a stage trace (once per (re)compile of the
 #: enclosing stage). The persistent compile cache consults this: a

@@ -546,6 +546,45 @@ def test_rebalance_state_math():
     assert len(st.slow) == 7
 
 
+def test_rebalance_weight_decay_returns_to_uniform():
+    """decayChunks > 0: a flagged shard earns its share back linearly
+    over that many rebalanced chunks — shares return to uniform, the
+    state goes inert (zero-cost padding path again), slot capacity
+    stays constant across the whole decay (stable jit shapes), and a
+    re-flag mid-decay resets the penalty to full."""
+    from spark_tpu.config import Conf
+    from spark_tpu.parallel.elastic import RebalanceState
+    conf = Conf()
+    conf.set("spark_tpu.sql.straggler.rebalance.decayChunks", 4)
+    st = RebalanceState(4, conf)
+    st.flag(1)
+    cap = st.slot_capacity(1024)
+    t0 = st.targets(1024)
+    assert t0[1] == 128  # (1 - 0.5) x fair at full penalty
+    shares = [t0[1]]
+    for _ in range(4):
+        st.tick()
+        if st.active:
+            assert st.slot_capacity(1024) == cap  # shape-stable decay
+            shares.append(int(st.targets(1024)[1]))
+    # monotonically recovering, and fully recovered at the end
+    assert shares == sorted(shares)
+    assert not st.active
+    even = st.targets(1024)
+    assert set(even) == {256}  # uniform again
+    # re-flag mid-decay resets to the full penalty
+    st.flag(2)
+    st.tick()
+    st.flag(2)
+    assert st.penalty[2] == 1.0
+    # decayChunks = 0 keeps the legacy stay-flagged-forever behavior
+    st0 = RebalanceState(4, Conf())
+    st0.flag(1)
+    for _ in range(10):
+        st0.tick()
+    assert st0.active and st0.slow == {1}
+
+
 def test_rebalance_batch_preserves_rows():
     """pad_chunk_for_shards with an active state moves rows between
     shard segments but never loses or duplicates a live row."""
