@@ -39,6 +39,7 @@ import contextlib
 import json
 import os
 import signal
+import tempfile
 import time
 
 import numpy as np
@@ -94,9 +95,42 @@ def _emit(section: str, status: str, t0: float, data: dict) -> None:
                       "data": data}), flush=True)
 
 
+#: the bench session whose flight recorder section failures dump
+#: bundles from (set by _arm_flight_recorder in main)
+_FLIGHTREC_SESSION = None
+
+
+def _arm_flight_recorder(spark) -> None:
+    """Arm the always-on flight recorder for every section: ring
+    recording on, bundles under the bench output dir, and the session
+    registered so _run_section can dump on a timeout/error."""
+    global _FLIGHTREC_SESSION
+    spark.conf.set("spark_tpu.sql.flightRecorder.enabled", "true")
+    spark.conf.set("spark_tpu.sql.flightRecorder.dir",
+                   os.path.join(tempfile.gettempdir(),
+                                "spark-tpu-bench-flightrec"))
+    _FLIGHTREC_SESSION = spark
+
+
+def _section_bundle(name: str, detail: str):
+    """Dump a flight-recorder bundle for a failed/timed-out section;
+    returns its path (None when unarmed or the dump failed)."""
+    if _FLIGHTREC_SESSION is None:
+        return None
+    from spark_tpu.observability.flight_recorder import FlightRecorder
+    rec = FlightRecorder.of(_FLIGHTREC_SESSION)
+    if rec is None:
+        return None
+    return rec.dump(f"bench_{name}", extra={"section": name,
+                                            "detail": detail})
+
+
 def _run_section(name: str, fn, budget_s: float) -> dict:
     """Run one bench section under its own deadline and emit its JSON
-    line immediately; always returns a dict (possibly {'error': ...})."""
+    line immediately; always returns a dict (possibly {'error': ...}).
+    A timeout or error additionally dumps a flight-recorder bundle and
+    carries its path in the JSON line ('bundle'): the post-mortem for
+    a wedged section starts from the bundle, not from rerunning it."""
     t0 = time.perf_counter()
     data = None
     try:
@@ -110,11 +144,15 @@ def _run_section(name: str, fn, budget_s: float) -> dict:
             # the deadline context disarming it: the section DID finish
             _emit(name, "ok", t0, data)
             return data
-        data = {f"{name}_error": f"section timeout after {budget_s:g}s"}
+        detail = f"section timeout after {budget_s:g}s"
+        data = {f"{name}_error": detail,
+                "bundle": _section_bundle(name, detail)}
         _emit(name, "timeout", t0, data)
         return data
     except Exception as e:  # noqa: BLE001
-        data = {f"{name}_error": f"{type(e).__name__}: {e}"[:300]}
+        detail = f"{type(e).__name__}: {e}"[:300]
+        data = {f"{name}_error": detail,
+                "bundle": _section_bundle(name, detail)}
         _emit(name, "error", t0, data)
         return data
 
@@ -720,14 +758,19 @@ def obs_conf_on(base_dir: str) -> dict:
             "spark_tpu.sql.metrics.sink": "jsonl,prometheus",
             "spark_tpu.sql.metrics.dir": base_dir + "/m",
             "spark_tpu.sql.observability.xlaCost": "on",
-            "spark_tpu.sql.observability.shardSpans": "on"}
+            "spark_tpu.sql.observability.shardSpans": "on",
+            "spark_tpu.sql.status.enabled": "true",
+            "spark_tpu.sql.flightRecorder.enabled": "true",
+            "spark_tpu.sql.flightRecorder.dir": base_dir + "/fr"}
 
 
 OBS_CONF_OFF = {"spark_tpu.sql.eventLog.dir": "",
                 "spark_tpu.sql.trace.dir": "",
                 "spark_tpu.sql.metrics.sink": "",
                 "spark_tpu.sql.observability.xlaCost": "off",
-                "spark_tpu.sql.observability.shardSpans": "off"}
+                "spark_tpu.sql.observability.shardSpans": "off",
+                "spark_tpu.sql.status.enabled": "false",
+                "spark_tpu.sql.flightRecorder.enabled": "false"}
 
 
 def measure_obs_overhead(spark, run, base_dir: str, best_of: int = 3
@@ -1066,6 +1109,7 @@ def main():
     from spark_tpu import SparkTpuSession
 
     spark = SparkTpuSession.builder().get_or_create()
+    _arm_flight_recorder(spark)
     budget = float(os.environ.get("BENCH_SECTION_BUDGET_S", "420"))
     total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "2400"))
     t_run0 = time.perf_counter()

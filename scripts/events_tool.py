@@ -21,6 +21,12 @@ enforceable in CI:
         Pretty-print the last N events (default 10): query id, status,
         wall seconds, top spans, fault/straggler notes.
 
+    scripts/events_tool.py stats <file-or-dir> [...]
+        Summarize the logs: per-record-type counts (query executions
+        by status, streaming batches, trigger ticks, shard/span
+        carriers), a schema-version histogram, and the time span
+        covered (first/last ts, wall duration).
+
 Wired into scripts/preflight.sh after the observability smoke, so a
 schema regression (a field rename, a non-serializable value degrading
 to repr) fails the gate instead of landing in a BENCH round.
@@ -314,8 +320,77 @@ def tail(targets, n: int = 10) -> list:
     return lines
 
 
+def stats(targets) -> list:
+    """Aggregate log statistics as printable lines: record-type
+    counts, schema-version histogram, covered time span."""
+    n_lines = 0
+    statuses: dict = {}
+    versions: dict = {}
+    kinds = {"streaming": 0, "trigger": 0, "with_shards": 0,
+             "with_spans": 0, "with_faults": 0}
+    ts_min = ts_max = None
+    files = _log_files(targets)
+    for path in files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(e, dict):
+                    continue
+                n_lines += 1
+                ver = e.get("schema_version")
+                versions[ver] = versions.get(ver, 0) + 1
+                ts = e.get("ts")
+                if isinstance(ts, (int, float)):
+                    ts_min = ts if ts_min is None else min(ts_min, ts)
+                    ts_max = ts if ts_max is None else max(ts_max, ts)
+                if "streaming" in e:
+                    kinds["streaming"] += 1
+                elif "trigger" in e:
+                    kinds["trigger"] += 1
+                else:
+                    st = e.get("status")
+                    statuses[st] = statuses.get(st, 0) + 1
+                if e.get("shards"):
+                    kinds["with_shards"] += 1
+                if e.get("spans"):
+                    kinds["with_spans"] += 1
+                if e.get("fault_summary"):
+                    kinds["with_faults"] += 1
+    lines = [f"files: {len(files)}  records: {n_lines}"]
+    execs = sum(statuses.values())
+    lines.append("executions: " + (
+        f"{execs} (" + ", ".join(
+            f"{s}={n}" for s, n in sorted(statuses.items(),
+                                          key=lambda kv: -kv[1]))
+        + ")" if execs else "0"))
+    lines.append(f"streaming batches: {kinds['streaming']}  "
+                 f"trigger ticks: {kinds['trigger']}")
+    lines.append(f"carrying shards/spans/faults: "
+                 f"{kinds['with_shards']}/{kinds['with_spans']}"
+                 f"/{kinds['with_faults']}")
+    lines.append("schema versions: " + (", ".join(
+        f"v{v}={n}" for v, n in sorted(
+            versions.items(), key=lambda kv: (kv[0] is None, kv[0])))
+        or "none"))
+    if ts_min is not None:
+        import datetime
+
+        def iso(t):
+            return datetime.datetime.fromtimestamp(t).isoformat(
+                timespec="seconds")
+        lines.append(f"time span: {iso(ts_min)} .. {iso(ts_max)} "
+                     f"({ts_max - ts_min:.1f}s)")
+    return lines
+
+
 def main(argv) -> int:
-    if not argv or argv[0] not in ("validate", "tail"):
+    if not argv or argv[0] not in ("validate", "tail", "stats"):
         print(__doc__)
         return 2
     cmd, rest = argv[0], argv[1:]
@@ -338,6 +413,10 @@ def main(argv) -> int:
             return 1
         nfiles = len(_log_files(rest))
         print(f"events_tool validate: ok ({nfiles} file(s))")
+        return 0
+    if cmd == "stats":
+        for line in stats(rest):
+            print(line)
         return 0
     for line in tail(rest, n):
         print(line)

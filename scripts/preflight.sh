@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Sixteen stages, all mandatory:
+# Seventeen stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -95,9 +95,18 @@
 #      FAILED status; a FRESH query over the same checkpoint must
 #      recover byte-identical to an uninterrupted twin; and after a
 #      clean stop ZERO spark-tpu-stream-trigger threads may survive
+#  17. status-store + flight-recorder smoke: GET /status on a live
+#      service must parse with latency p50/p95/p99 present after one
+#      query, /status/timeseries must carry heartbeat-sampled series,
+#      and a query failed by an injected `stage_run:fatal` must leave
+#      a flight-recorder bundle whose spans + conf snapshot + thread
+#      stacks all parse — the crash-time diagnostics must exist
+#      exactly when a query dies. (The ≤10% observability-overhead
+#      gate in stage 5 already measures with the status store and
+#      flight recorder ON: bench.py's obs_conf_on includes both.)
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-16 still run) for
+#   --fast skips the full pytest suite (stages 2-17 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -110,7 +119,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/16: tier-1 test suite --"
+    echo "-- stage 1/17: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -124,16 +133,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/16: SKIPPED (--fast) --"
+    echo "-- stage 1/17: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/16: dryrun_multichip(8) --"
+echo "-- stage 2/17: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/16: bench smoke --"
+echo "-- stage 3/17: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -165,7 +174,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/16: chaos smoke --"
+echo "-- stage 4/17: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -219,7 +228,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/16: observability + analysis smoke --"
+echo "-- stage 5/17: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -312,10 +321,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/16: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/17: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/16: SQL service smoke --"
+echo "-- stage 7/17: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -389,7 +398,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/16: join-kernel + ingest parity smoke --"
+echo "-- stage 8/17: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -447,7 +456,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/16: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/17: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -491,7 +500,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/16: elastic mesh smoke --"
+echo "-- stage 10/17: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -541,7 +550,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/16: streaming durability smoke --"
+echo "-- stage 11/17: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -634,7 +643,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/16: concurrency smoke --"
+echo "-- stage 12/17: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -717,7 +726,7 @@ print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
 
-echo "-- stage 13/16: compile-cache smoke --"
+echo "-- stage 13/17: compile-cache smoke --"
 # Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
 # subprocess over the same dir must open warm (disk_hits >= 1, ZERO
 # disk misses = no backend recompiles of cached shapes) with
@@ -814,7 +823,7 @@ print(json.dumps({"preflight_compile_cache_smoke": "ok",
                   "corrupt_recovered": fixed["corrupt"]}))
 EOF11
 
-echo "-- stage 14/16: query-lifecycle cancellation smoke --"
+echo "-- stage 14/17: query-lifecycle cancellation smoke --"
 # Start a chunked Q3 via the service, DELETE it mid-stream, assert the
 # structured error + no thread leak + arbiter drained + an immediate
 # clean re-run at golden parity (the cancellation hard guarantee).
@@ -910,7 +919,7 @@ print(json.dumps({"preflight_cancellation_smoke": "ok",
                   "cancel_latency_s": round(latency_s, 3)}))
 EOF12
 
-echo "-- stage 15/16: python-UDF worker pool smoke --"
+echo "-- stage 15/17: python-UDF worker pool smoke --"
 # Worker-lane parity with in-process, an injected SIGKILL mid-batch
 # replaying exactly one batch, and the zero-leaked-children contract.
 env JAX_PLATFORMS=cpu python - <<'EOF13'
@@ -975,7 +984,7 @@ print(json.dumps({
     "workers_spawned": len(s._udf_pool.child_procs())}))
 EOF13
 
-echo "-- stage 16/16: unattended streaming smoke --"
+echo "-- stage 16/17: unattended streaming smoke --"
 # Socket producer under the supervised trigger loop: a mid-stream
 # connection kill must reconnect exactly once with zero loss, an
 # injected trigger_tick fatal must park the query in structured FAILED,
@@ -1084,5 +1093,114 @@ print(json.dumps({
     "committed_batches": int(q2._committed_batch + 1),
     "groups": int(len(got))}))
 EOF14
+
+echo "-- stage 17/17: status store + flight recorder smoke --"
+# Live /status must parse with latency percentiles after one query,
+# /status/timeseries must carry heartbeat-sampled series, and an
+# injected stage_run fatal must leave a flight-recorder bundle whose
+# spans + conf + thread stacks parse.
+env JAX_PLATFORMS=cpu python - <<'EOF15'
+import glob
+import json
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+from spark_tpu import Conf
+from spark_tpu.service.server import SqlService
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch import sql_queries as SQLQ
+from spark_tpu.tpch.datagen import write_parquet
+
+base = tempfile.mkdtemp(prefix="preflight_status_")
+path = base + "/sf"
+fr_dir = base + "/flightrec"
+write_parquet(path, 0.001)
+
+conf = Conf()
+conf.set("spark_tpu.service.port", 0)
+conf.set("spark_tpu.sql.status.heartbeatMs", 50)
+conf.set("spark_tpu.sql.flightRecorder.dir", fr_dir)
+svc = SqlService(conf,
+                 init_session=lambda s: Q.register_tables(s, path)).start()
+
+
+def post(body, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}/sql",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(route):
+    return json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{svc.port}{route}", timeout=30))
+
+
+try:
+    status, body = post({"sql": SQLQ.Q1})
+    assert status == 200 and body["status"] == "ok", (status, body)
+
+    # (a) /status parses; latency percentiles present after the query
+    st = get("/status")
+    assert st["enabled"] is True, st
+    e2e = st["latency"]["e2e_ms"]
+    assert e2e["count"] >= 1, e2e
+    for pk in ("p50", "p95", "p99"):
+        assert isinstance(e2e[pk], (int, float)), (pk, e2e)
+    assert st["statuses"].get("ok", 0) >= 1, st["statuses"]
+    assert "admission" in st["providers"], st["providers"]
+    assert "arbiter" in st["providers"], st["providers"]
+
+    # (b) heartbeat-sampled time series accumulate in bounded rings
+    deadline = time.monotonic() + 15
+    ts = get("/status/timeseries")
+    while time.monotonic() < deadline and ts["heartbeats"] < 3:
+        time.sleep(0.05)
+        ts = get("/status/timeseries")
+    assert ts["heartbeats"] >= 3, ts["heartbeats"]
+    assert ts["series"], "no time series sampled"
+    for pts in ts["series"].values():
+        assert len(pts) <= ts["ring_capacity"], (len(pts), ts)
+
+    # (c) injected fatal fails the query AND leaves a parseable bundle
+    status, body = post({
+        "sql": SQLQ.Q1,
+        "conf": {"spark_tpu.faults.inject": "stage_run:fatal:1"}})
+    assert status != 200 and body.get("error"), (status, body)
+    bundles = glob.glob(os.path.join(fr_dir, "bundle-*"))
+    assert len(bundles) == 1, bundles
+    b = bundles[0]
+    manifest = json.load(open(os.path.join(b, "MANIFEST.json")))
+    assert manifest["reason"] == "fatal", manifest
+    assert "FaultInjected" in manifest["error"], manifest
+    spans = json.load(open(os.path.join(b, "spans.json")))
+    assert any(spans["spans"].values()), spans
+    conf_snap = json.load(open(os.path.join(b, "conf.json")))
+    assert "spark_tpu.faults.inject" in conf_snap["explicitly_set"], \
+        conf_snap["explicitly_set"]
+    threads = open(os.path.join(b, "threads.txt")).read()
+    assert "MainThread" in threads or "Thread-" in threads, threads[:200]
+    rings = [json.loads(line)
+             for line in open(os.path.join(b, "rings.jsonl"))]
+    assert {"query", "stage"} <= {r["subsystem"] for r in rings}, rings
+
+    # the failed query is visible in /status too
+    st2 = get("/status")
+    assert st2["statuses"].get("error", 0) >= 1, st2["statuses"]
+finally:
+    svc.stop()
+print(json.dumps({"preflight_status_smoke": "ok",
+                  "heartbeats": int(ts["heartbeats"]),
+                  "series": len(ts["series"]),
+                  "bundle": os.path.basename(b)}))
+EOF15
 
 echo "== preflight PASSED =="

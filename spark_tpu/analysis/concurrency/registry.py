@@ -139,6 +139,16 @@ LOCKS: Tuple[LockDecl, ...] = (
              "device table cache (rlock: arbiter eviction may reenter)"),
     LockDecl("obs.straggler", _OBS + "straggler.py", "StragglerMonitor",
              "_lock", "lock", 44, "rolling per-shard wait windows"),
+    LockDecl("obs.status", _OBS + "status_store.py", "StatusStore",
+             "_lock", "lock", 45,
+             "status-store rings + session attribution; providers and "
+             "metrics calls run OUTSIDE it (they take service-layer "
+             "locks ranked below), so only dict/deque ops sit inside"),
+    LockDecl("obs.flightrec", _OBS + "flight_recorder.py",
+             "FlightRecorder", "_lock", "lock", 46,
+             "flight-recorder rings + retained plan/span maps; dump "
+             "file I/O and conf/metrics snapshots run OUTSIDE it over "
+             "copies"),
     LockDecl("obs.bus", _OBS + "listener.py", "ListenerBus", "_lock",
              "lock", 48,
              "listener list + drop counter (delivery runs OUTSIDE it)"),
@@ -172,6 +182,10 @@ LOCKS: Tuple[LockDecl, ...] = (
              "lock", 80, "per-counter read-modify-write (leaf)"),
     LockDecl("metrics.timer", _OBS + "metrics.py", "Timer", "_lock",
              "lock", 81, "per-timer observation (leaf)"),
+    LockDecl("metrics.histogram", _OBS + "metrics.py", "Histogram",
+             "_lock", "lock", 82,
+             "per-histogram bucket counters (leaf; bucket index is "
+             "computed before acquiring it)"),
     LockDecl("testing.lockwatch", "spark_tpu/testing/lockwatch.py",
              "LockWatch", "_mu", "lock", 95,
              "lockwatch's own recorder lock: acquired inside every "
@@ -196,6 +210,13 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
               "_lock"),
     GuardDecl(_OBS + "metrics.py", "MetricsRegistry", "_timers",
               "_lock"),
+    GuardDecl(_OBS + "metrics.py", "MetricsRegistry", "_histograms",
+              "_lock"),
+    GuardDecl(_OBS + "metrics.py", "Histogram", "counts", "_lock"),
+    GuardDecl(_OBS + "metrics.py", "Histogram", "count", "_lock"),
+    GuardDecl(_OBS + "metrics.py", "Histogram", "total", "_lock"),
+    GuardDecl(_OBS + "metrics.py", "Histogram", "min_v", "_lock"),
+    GuardDecl(_OBS + "metrics.py", "Histogram", "max_v", "_lock"),
     # device cache
     GuardDecl("spark_tpu/io/device_cache.py", "DeviceTableCache",
               "_entries", "_lock"),
@@ -248,6 +269,34 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
     GuardDecl(_OBS + "listener.py", "ListenerBus", "_listeners",
               "_lock"),
     GuardDecl(_OBS + "listener.py", "ListenerBus", "dropped", "_lock"),
+    # status store
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_series",
+              "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_inflight",
+              "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_sessions",
+              "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore",
+              "_status_counts", "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_phase_totals",
+              "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_queries_total",
+              "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_heartbeats",
+              "_lock"),
+    GuardDecl(_OBS + "status_store.py", "StatusStore", "_providers",
+              "_lock"),
+    # flight recorder
+    GuardDecl(_OBS + "flight_recorder.py", "FlightRecorder", "_rings",
+              "_lock"),
+    GuardDecl(_OBS + "flight_recorder.py", "FlightRecorder", "_plans",
+              "_lock"),
+    GuardDecl(_OBS + "flight_recorder.py", "FlightRecorder", "_trees",
+              "_lock"),
+    GuardDecl(_OBS + "flight_recorder.py", "FlightRecorder", "_spans",
+              "_lock"),
+    GuardDecl(_OBS + "flight_recorder.py", "FlightRecorder", "_seq",
+              "_lock"),
     # udf worker pool
     GuardDecl("spark_tpu/udf_worker/pool.py", "UdfWorkerPool", "_idle",
               "_cv"),
@@ -333,6 +382,14 @@ WAIVERS: Tuple[Waiver, ...] = (
            "lifecycle attr written by the owning control thread in "
            "start()/stop(); the thread itself only fills the "
            "arbiter's waived stage_cache dict"),
+    Waiver(_OBS + "status_store.py", "StatusStore", "_thread",
+           "lifecycle attr written by the owning control thread in "
+           "start()/stop(), not on the request path (the "
+           "SqlService._serve_thread precedent)"),
+    Waiver(_OBS + "status_store.py", "StatusStore", "_stop_event",
+           "threading.Event is internally synchronized; clear() runs "
+           "in start() before the heartbeat thread exists, set() in "
+           "stop() is the cross-thread signal it exists for"),
     # module-level globals (cls="" and attr=global name)
     Waiver("spark_tpu/testing/faults.py", "", "_PLAN",
            "atomic reference rebind at execute_batch entry / test "
@@ -354,6 +411,10 @@ WAIVERS: Tuple[Waiver, ...] = (
            "mutated only by the test harness thread during "
            "install()/uninstall(), before/after the watched "
            "concurrency runs"),
+    Waiver("spark_tpu/testing/lockwatch.py", "", "_CURRENT",
+           "GIL-atomic reference rebind by the test harness thread in "
+           "watch_attr()/uninstall(); the flight recorder's dump only "
+           "reads a point-in-time reference"),
     Waiver("spark_tpu/udf_worker/pool.py", "UdfWorkerPool",
            "max_workers",
            "GIL-atomic scalar refresh from conf at each worker-mode "
@@ -419,6 +480,8 @@ RECEIVER_ATTRS: Dict[str, str] = {
     "pool": "SessionPool",
     "bus": "ListenerBus",
     "listeners": "ListenerBus",
+    "status_store": "StatusStore",
+    "_store": "StatusStore",
 }
 
 #: factory methods whose RETURN value is an instance of another known
@@ -427,6 +490,7 @@ FACTORY_RETURNS: Dict[Tuple[str, str], str] = {
     ("MetricsRegistry", "counter"): "Counter",
     ("MetricsRegistry", "timer"): "Timer",
     ("MetricsRegistry", "gauge"): "Gauge",
+    ("MetricsRegistry", "histogram"): "Histogram",
 }
 
 #: `with <recv>.<method>(...):` context managers that hold a
@@ -511,6 +575,24 @@ EXTRA_EDGES: Tuple[Tuple[str, str, str], ...] = (
     # runs under its session lease (execution/python_eval.py)
     ("service.session", "udf.pool", "worker checkout/checkin during "
      "UDF evaluation under the lease"),
+    # status-store per-session feed: the bus delivers query start/end
+    # synchronously on the worker thread holding the session lease
+    ("service.session", "obs.status", "status-store feed folds "
+     "query start/end attribution under the lease"),
+    # flight recorder: same synchronous delivery, plus the executor's
+    # crash-dump trigger runs inside the lease
+    ("service.session", "obs.flightrec", "flight-recorder ring "
+     "appends and crash dumps under the lease"),
+    ("service.session", "metrics.histogram", "latency histogram "
+     "observations at query end under the lease"),
+    # pool._create wires the status-store feed while holding the pool
+    # lock (SqlService._make_listener -> status_store.bind)
+    ("service.pool", "obs.status", "session creation binds the "
+     "status-store feed under the pool lock"),
+    # registry.snapshot() serializes each histogram under its own leaf
+    # lock while holding the instrument-map lock
+    ("metrics.registry", "metrics.histogram", "MetricsRegistry."
+     "snapshot reads histogram snapshots under the registry lock"),
 )
 
 

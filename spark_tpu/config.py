@@ -800,6 +800,67 @@ SERVICE_QUERY_LOG_SIZE = register(
         "finished records are dropped past it.",
     validator=lambda v: v >= 1)
 
+STATUS_ENABLED = register(
+    "spark_tpu.sql.status.enabled", True,
+    doc="Feed the engine status store: record end-to-end and per-phase "
+        "query latency histograms (status_latency_ms / "
+        "status_phase_ms_*) and SLO burn counters at every query end, "
+        "and let the service's status heartbeat sample health gauges "
+        "into its ring time-series (GET /status, /status/timeseries). "
+        "Off silences the recording, not the endpoints (they serve "
+        "whatever was recorded).")
+
+STATUS_HEARTBEAT_MS = register(
+    "spark_tpu.sql.status.heartbeatMs", 1000,
+    doc="Interval of the status store's heartbeat thread (the "
+        "Heartbeater analog): every tick samples queries in flight, "
+        "admission queue depth, arbiter lease occupancy, cache hit "
+        "rates, streaming lag and UDF pool size into the fixed-"
+        "capacity ring time-series behind GET /status/timeseries.",
+    validator=lambda v: v >= 10)
+
+STATUS_RING_SIZE = register(
+    "spark_tpu.sql.status.ringSize", 360,
+    doc="Capacity of each status-store ring time-series (oldest "
+        "samples drop past it); 360 x the 1s default heartbeat = a "
+        "rolling 6-minute window per series.",
+    validator=lambda v: v >= 2)
+
+SERVICE_SLO_LATENCY_MS = register(
+    "spark_tpu.service.slo.latencyMs", 0,
+    doc="End-to-end query latency SLO target in ms. When > 0, every "
+        "query end counts slo_queries_total and a query slower than "
+        "the target burns slo_burned_total / slo_burn_ms_total — the "
+        "counters a fleet router sheds on. 0 disables burn counting "
+        "(the latency histograms record regardless).",
+    validator=lambda v: v >= 0)
+
+FLIGHTREC_ENABLED = register(
+    "spark_tpu.sql.flightRecorder.enabled", True,
+    doc="Keep the always-on flight recorder ring (recent events/spans/"
+        "fault records per subsystem, bounded, near-zero hot-path "
+        "cost) and dump a diagnostic bundle on FATAL errors, OOM-"
+        "ladder exhaustion, non-convergent recovery, or on demand "
+        "(GET /debug/bundle). Off disables both ring and dumps.")
+
+FLIGHTREC_DIR = register(
+    "spark_tpu.sql.flightRecorder.dir", "",
+    doc="Directory diagnostic bundles are dumped under (one versioned "
+        "bundle-<app>-<n>-<reason>/ per dump). Empty uses "
+        "<tmpdir>/spark-tpu-flightrec.")
+
+FLIGHTREC_RING_SIZE = register(
+    "spark_tpu.sql.flightRecorder.ringSize", 256,
+    doc="Per-subsystem bound on flight-recorder ring records (oldest "
+        "drop past it).",
+    validator=lambda v: v >= 8)
+
+FLIGHTREC_EVENT_TAIL = register(
+    "spark_tpu.sql.flightRecorder.eventLogTail", 200,
+    doc="How many trailing event-log lines a diagnostic bundle "
+        "includes (when eventLog.dir is set).",
+    validator=lambda v: v >= 0)
+
 SERVICE_HISTORY_SIZE = register(
     "spark_tpu.service.historySize", 128,
     doc="Bound on the service's in-memory per-query detail store "

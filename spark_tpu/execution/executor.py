@@ -1038,6 +1038,7 @@ class QueryExecution:
             raise
         except Exception as e:  # noqa: BLE001 — observe, then surface
             self._post_query_end(None, status="error", error=e)
+            self._flightrec_dump(e)
             raise
         finally:
             res_arbiter.exit_query(arb_token)
@@ -1117,6 +1118,38 @@ class QueryExecution:
         if pool is not None:
             pool.shutdown()
         self._post_query_end(None, status=status, error=e)
+
+    def _flightrec_dump(self, e: Exception) -> None:
+        """Crash-time diagnostics for a SURFACED failure (the recovery
+        ladder gave up): classify the terminal error and ask the
+        session's flight recorder for a bundle. Cancels/deadlines take
+        the `_observe_cancel` path and deliberately never dump —
+        stopping a query is lifecycle, not a crash. Never raises, and
+        works with events off: the recorder's rings may be sparse then,
+        but plan + fault summary ride along in `extra`."""
+        try:
+            from ..observability.flight_recorder import FlightRecorder
+            rec = FlightRecorder.of(self.session)
+            if rec is None:
+                return
+            from .failures import StageOOMError
+            if isinstance(e, StageOOMError):
+                reason = "oom"
+            elif ("recovery did not converge" in str(e)
+                  and isinstance(e, RuntimeError)):
+                reason = "recovery_nonconvergent"
+            else:
+                reason = "fatal"
+            rec.dump(reason, extra={
+                "query_id": self.query_id,
+                "plan": self.logical.tree_string()[:2000],
+                "fault_summary": {
+                    k: v for k, v in self.fault_summary.items()
+                    if k != "events"},
+            }, error=e)
+        except Exception as dump_err:  # noqa: BLE001 — diagnostics only
+            import warnings
+            warnings.warn(f"flight-recorder trigger failed: {dump_err}")
 
     def _mesh_replan(self, mesh_size: Optional[int] = None) -> None:
         """Shared reset for the elastic-ladder rungs that change the

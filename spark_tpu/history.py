@@ -545,3 +545,40 @@ def runtime_filter_summary(events: pd.DataFrame) -> pd.DataFrame:
                 "build_ms": r.get(f"rtf_build_ms_{tag}"),
             })
     return pd.DataFrame(rows)
+
+
+def status_summary(events: pd.DataFrame) -> pd.DataFrame:
+    """Offline replay of the live status store: the per-app health
+    view `GET /status` serves, rebuilt from a read_event_log frame —
+    one row per app with per-status outcome counts, cumulative
+    per-phase seconds, and end-to-end latency percentiles (sum of the
+    phase_*_s columns per execution, in ms). Rows with no phase data
+    (streaming/trigger lines) are excluded: they are lifecycle
+    records, not query executions."""
+    rows: List[dict] = []
+    phase_cols = [c for c in events.columns
+                  if c.startswith("phase_") and c.endswith("_s")]
+    if not phase_cols or "app" not in events.columns:
+        return pd.DataFrame(rows)
+    execs = events[events[phase_cols].notna().any(axis=1)].copy()
+    if execs.empty:
+        return pd.DataFrame(rows)
+    execs["e2e_ms"] = execs[phase_cols].sum(axis=1,
+                                            skipna=True) * 1e3
+    for app, grp in execs.groupby("app"):
+        row = {"app": app, "queries": len(grp)}
+        statuses = grp["status"].value_counts() \
+            if "status" in grp.columns else {}
+        for status, n in dict(statuses).items():
+            row[f"n_{status}"] = int(n)
+        for c in phase_cols:
+            total = grp[c].sum(skipna=True)
+            if total:
+                row[c.replace("phase_", "total_", 1)] = round(
+                    float(total), 4)
+        q = grp["e2e_ms"].quantile
+        row["p50_ms"] = round(float(q(0.50)), 3)
+        row["p95_ms"] = round(float(q(0.95)), 3)
+        row["p99_ms"] = round(float(q(0.99)), 3)
+        rows.append(row)
+    return pd.DataFrame(rows)

@@ -18,31 +18,43 @@ the engine-sized analog, organized the same way:
   (``compiled.cost_analysis()`` / ``memory_analysis()``) — flops,
   bytes accessed, argument/output/temp sizes and the derived peak-HBM
   demand per compiled stage.
-- ``metrics``: process metrics registry (counters/gauges/timers) with
-  JSONL + Prometheus text-exposition sinks, plus the registered
-  traced-metric name prefixes ``scripts/metrics_lint.py`` enforces.
+- ``metrics``: process metrics registry (counters/gauges/timers/
+  log-bucketed latency histograms) with JSONL + Prometheus
+  text-exposition sinks, plus the registered traced-metric name
+  prefixes ``scripts/metrics_lint.py`` enforces.
 - ``sinks``: the built-in bus subscribers (event-log writer with
   rotation, Chrome-trace writer, metrics-sink updater) a session
   installs at construction.
+- ``status_store``: the ``AppStatusStore`` seat — bounded, typed,
+  listener-bus-fed rolling view of engine health (in-flight queries,
+  queue depth, lease occupancy, cache hit rates, latency percentiles,
+  SLO burn), heartbeat-sampled into ring time-series and served by
+  the SQL service's ``GET /status`` endpoints.
+- ``flight_recorder``: always-on bounded rings of recent events per
+  subsystem; dumps a self-contained diagnostic bundle (rings, plans,
+  conf, metrics, thread stacks, event-log tail) on FATAL / OOM-ladder
+  exhaustion / non-convergent recovery or on demand.
 """
 
 from .listener import (AnalysisEvent, FaultEvent, ListenerBus,
                        QueryEndEvent, QueryListener, QueryStartEvent,
                        ServiceEvent, ShardChunkEvent, StageCompiledEvent,
                        StageCompletedEvent, StragglerEvent)
-from .metrics import (METRIC_PREFIXES, MetricsRegistry,
+from .flight_recorder import FlightRecorder
+from .metrics import (METRIC_PREFIXES, Histogram, MetricsRegistry,
                       is_registered_metric)
 from .spans import (ShardStreamTelemetry, Span, SpanRecorder,
                     current_shard_telemetry, to_chrome_trace,
                     use_shard_telemetry)
+from .status_store import StatusStore
 from .straggler import StragglerMonitor
 
 __all__ = [
-    "AnalysisEvent", "FaultEvent", "ListenerBus", "MetricsRegistry",
-    "METRIC_PREFIXES",
+    "AnalysisEvent", "FaultEvent", "FlightRecorder", "Histogram",
+    "ListenerBus", "MetricsRegistry", "METRIC_PREFIXES",
     "QueryEndEvent", "QueryListener", "QueryStartEvent", "ServiceEvent",
     "ShardChunkEvent", "ShardStreamTelemetry", "Span", "SpanRecorder",
-    "StageCompiledEvent", "StageCompletedEvent", "StragglerEvent",
-    "StragglerMonitor", "current_shard_telemetry",
+    "StageCompiledEvent", "StageCompletedEvent", "StatusStore",
+    "StragglerEvent", "StragglerMonitor", "current_shard_telemetry",
     "is_registered_metric", "to_chrome_trace", "use_shard_telemetry",
 ]

@@ -22,12 +22,13 @@ call site — so history summaries can never silently miss columns.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import re
 import threading
 import time
-from typing import Dict
+from typing import Dict, List
 
 # ---------------------------------------------------------------------------
 # Traced-metric name registry (the SQLMetrics naming discipline)
@@ -101,6 +102,20 @@ METRIC_PREFIXES = (
     # crash/timeout, and spawn+handshake wall-clock
     "udf_",            # udf_batches/udf_rows/udf_exec_ms/
                        # udf_worker_restarts/udf_worker_spawn_ms
+    # engine status store (observability/status_store.py + the metrics
+    # sink listener): REGISTRY histograms/counters/gauges, listed for
+    # namespace closure — end-to-end and per-phase latency
+    # distributions, heartbeat samples, queries in flight
+    "status_",         # status_latency_ms (e2e histogram)/
+                       # status_phase_ms_<phase>/status_class_ms_<cls>/
+                       # status_heartbeats/status_queries_inflight
+    # SLO burn tracking against spark_tpu.service.slo.latencyMs:
+    # REGISTRY counters a fleet router sheds on
+    "slo_",            # slo_queries_total/slo_burned_total/
+                       # slo_burn_ms_total
+    # flight recorder (observability/flight_recorder.py): REGISTRY
+    # counters, listed for namespace closure
+    "flightrec_",      # flightrec_bundles: diagnostic bundles dumped
 )
 
 
@@ -157,8 +172,87 @@ class Timer:
             self.max_s = max(self.max_s, seconds)
 
 
+class Histogram:
+    """Log-bucketed value distribution (the latency-SLO metric type).
+
+    Fixed power-of-two bucket boundaries (0.25 ms .. ~17.5 min for the
+    default ms domain) so two processes' histograms are always
+    mergeable and the Prometheus exposition is stable. `observe` is a
+    bisect + one lock-guarded increment — cheap enough for every query
+    end under the concurrent service. Quantiles interpolate linearly
+    inside the landing bucket (the classic log-histogram estimate),
+    clamped by the observed min/max so tiny-count histograms don't
+    report a bucket bound nobody measured."""
+
+    __slots__ = ("bounds", "counts", "count", "total", "min_v", "max_v",
+                 "_lock")
+
+    #: upper bounds, 2^-2 .. 2^20 — in ms: 0.25ms up to ~17.5 minutes
+    DEFAULT_BOUNDS = tuple(2.0 ** i for i in range(-2, 21))
+
+    def __init__(self):
+        self.bounds = self.DEFAULT_BOUNDS
+        #: one slot per bound + the overflow bucket
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min_v = float("inf")
+        self.max_v = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.total += value
+            if value < self.min_v:
+                self.min_v = value
+            if value > self.max_v:
+                self.max_v = value
+
+    def _quantile_locked(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, n in enumerate(self.counts):
+            if n == 0:
+                continue
+            if cum + n >= target:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) \
+                    else self.max_v
+                frac = (target - cum) / n
+                est = lo + (hi - lo) * frac
+                return min(max(est, self.min_v), self.max_v)
+            cum += n
+        return self.max_v
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def percentiles(self) -> Dict[str, float]:
+        """{p50, p95, p99} in one lock acquisition (the /status shape)."""
+        with self._lock:
+            return {"p50": round(self._quantile_locked(0.50), 3),
+                    "p95": round(self._quantile_locked(0.95), 3),
+                    "p99": round(self._quantile_locked(0.99), 3)}
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"count": self.count,
+                    "sum": round(self.total, 6),
+                    "min": round(self.min_v, 6) if self.count else 0.0,
+                    "max": round(self.max_v, 6),
+                    "bounds": list(self.bounds),
+                    "counts": list(self.counts)}
+
+
 class MetricsRegistry:
-    """Named counters/gauges/timers, created on first use."""
+    """Named counters/gauges/timers/histograms, created on first use."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -168,6 +262,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._timers: Dict[str, Timer] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def _get(self, store, name, cls):
         with self._lock:
@@ -185,6 +280,13 @@ class MetricsRegistry:
     def timer(self, name: str) -> Timer:
         return self._get(self._timers, name, Timer)
 
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._histograms, name, Histogram)
+
+    def histogram_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._histograms)
+
     def snapshot(self) -> Dict:
         with self._lock:
             return {
@@ -196,6 +298,8 @@ class MetricsRegistry:
                                          if t.count else 0.0),
                                "max_s": round(t.max_s, 6)}
                            for k, t in self._timers.items()},
+                "histograms": {k: h.snapshot()
+                               for k, h in self._histograms.items()},
             }
 
     # -- sinks --------------------------------------------------------------
@@ -252,9 +356,24 @@ def prometheus_text(snapshot: Dict) -> str:
         lines += [f"# TYPE {p} gauge", f"{p} {v}"]
     for name, t in sorted(snapshot.get("timers", {}).items()):
         p = _prom_name(name)
+        # legacy pair kept for existing scrapers, plus the native
+        # summary form (`_sum`/`_count`) the round-trip contract names
         lines += [f"# TYPE {p}_count counter", f"{p}_count {t['count']}",
                   f"# TYPE {p}_seconds_total counter",
-                  f"{p}_seconds_total {t['total_s']}"]
+                  f"{p}_seconds_total {t['total_s']}",
+                  f"# TYPE {p}_seconds summary",
+                  f"{p}_seconds_sum {t['total_s']}",
+                  f"{p}_seconds_count {t['count']}"]
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} histogram")
+        cum = 0
+        for le, n in zip(h["bounds"], h["counts"]):
+            cum += n
+            lines.append(f'{p}_bucket{{le="{le:g}"}} {cum}')
+        cum += h["counts"][-1]
+        lines += [f'{p}_bucket{{le="+Inf"}} {cum}',
+                  f"{p}_sum {h['sum']}", f"{p}_count {h['count']}"]
     return "\n".join(lines) + "\n"
 
 
@@ -266,21 +385,36 @@ def write_prometheus(path: str, snapshot: Dict) -> None:
     os.replace(tmp, path)
 
 
+#: one exposition sample: `name value` or `name{label="v",...} value`
+#: (the labeled form is what histogram `_bucket{le="..."}` series use)
+_PROM_SAMPLE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'((?:\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?)'
+    r'\s+(\S+)$')
+
+
 def parse_prometheus_text(text: str) -> Dict[str, float]:
-    """Scrape-parse text exposition back to {name: value} (tests and
-    the preflight smokes prove the output is consumable this way)."""
+    """Scrape-parse text exposition back to {series: value} (tests and
+    the preflight smokes prove the output is consumable this way).
+    Labeled samples keep their label set in the key — a histogram
+    bucket round-trips as e.g. `spark_tpu_status_latency_ms_bucket`
+    `{le="4"}`; unlabeled series keep the bare name, so every consumer
+    written against the counter/gauge/timer output keeps working."""
     out: Dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        parts = line.split()
-        if len(parts) != 2:
+        m = _PROM_SAMPLE.match(line)
+        if not m:
             raise ValueError(f"unparseable exposition line: {line!r}")
-        name, value = parts
-        if _PROM_BAD.search(name):
-            raise ValueError(f"invalid metric name: {name!r}")
-        out[name] = float(value)
+        name, labels, value = m.groups()
+        try:
+            out[name + labels] = float(value)
+        except ValueError:
+            raise ValueError(
+                f"non-numeric sample value in line: {line!r}")
     return out
 
 

@@ -198,6 +198,10 @@ class MetricsSinkListener(QueryListener):
 
     _builtin = True
 
+    #: SLO knob: 0 disables burn accounting
+    SLO_KEY = "spark_tpu.service.slo.latencyMs"
+    STATUS_KEY = "spark_tpu.sql.status.enabled"
+
     def __init__(self, session):
         self._session = session
 
@@ -240,7 +244,37 @@ class MetricsSinkListener(QueryListener):
                 m.gauge(f"device_cache_{name}").set(value)
         except Exception:  # noqa: BLE001 — gauges are best-effort
             pass
+        self._observe_latency(event, phases)
         m.flush(self._session.conf)
+
+    def _observe_latency(self, event: QueryEndEvent, phases) -> None:
+        """Log-bucketed latency histograms + SLO burn counters (the
+        AppStatusStore's taskTime/SQL-metrics percentile seat):
+        end-to-end and per-phase distributions, a per-query-class
+        distribution keyed by the plan's root operator, and — when
+        `service.slo.latencyMs` > 0 — attainment counters for the
+        `/status` burn-rate line. Conf-gated at event time on
+        `sql.status.enabled` (histograms off ⇒ zero cost here)."""
+        if not phases:
+            return  # streaming/trigger lines carry no phase data
+        if not bool(self._session.conf.get(self.STATUS_KEY)):
+            return
+        m = self._session.metrics
+        e2e_ms = sum(float(v) for v in phases.values()) * 1e3
+        m.histogram("status_latency_ms").observe(e2e_ms)
+        for phase, secs in phases.items():
+            m.histogram(f"status_phase_ms_{phase}").observe(
+                float(secs) * 1e3)
+        cls = _query_class(event.event.get("plan"))
+        if cls:
+            m.histogram(f"status_class_ms_{cls}").observe(e2e_ms)
+        target_ms = int(self._session.conf.get(self.SLO_KEY))
+        if target_ms > 0:
+            m.counter("slo_queries_total").inc()
+            if e2e_ms > target_ms:
+                m.counter("slo_burned_total").inc()
+                m.counter("slo_burn_ms_total").inc(
+                    int(e2e_ms - target_ms))
 
     def on_streaming_batch(self, event: StreamingBatchEvent) -> None:
         # the streaming_* counters are incremented at the source
@@ -256,16 +290,32 @@ class MetricsSinkListener(QueryListener):
         self._session.metrics.flush(self._session.conf)
 
 
+_CLASS_TOKEN = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _query_class(plan) -> str:
+    """Query-class label for per-class latency histograms: the plan's
+    root operator name (first identifier of the plan string — stable
+    across literal/column differences, bounded cardinality: one class
+    per operator type, not per query)."""
+    if not plan:
+        return ""
+    m = _CLASS_TOKEN.search(str(plan)[:80])
+    return m.group(0)[:24].lower() if m else ""
+
+
 def install_default_listeners(session) -> None:
     """Register the built-in subscribers on a session's bus (order
-    matters only for determinism: event log, trace, metrics,
-    straggler monitor, elastic rebalancer — the rebalancer AFTER the
-    monitor that feeds it)."""
+    matters only for determinism: event log, trace, metrics, flight
+    recorder, straggler monitor, elastic rebalancer — the rebalancer
+    AFTER the monitor that feeds it)."""
     from ..parallel.elastic import ElasticRebalancer
+    from .flight_recorder import FlightRecorder
     from .straggler import StragglerMonitor
     session.listeners.register(EventLogListener(session))
     session.listeners.register(ChromeTraceListener(session))
     session.listeners.register(MetricsSinkListener(session))
+    session.listeners.register(FlightRecorder(session))
     session.listeners.register(StragglerMonitor(session))
     session.listeners.register(ElasticRebalancer())
 

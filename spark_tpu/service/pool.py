@@ -36,10 +36,12 @@ class PoolExhausted(RuntimeError):
 
 
 class _Entry:
-    __slots__ = ("session", "lock", "current_record", "ready",
+    __slots__ = ("name", "session", "lock", "current_record", "ready",
                  "init_error")
 
-    def __init__(self, session):
+    def __init__(self, session, name: str = "default"):
+        #: pool name: status-store attribution label for this session
+        self.name = name
         self.session = session
         self.lock = threading.Lock()
         #: the service query record currently executing on this
@@ -76,7 +78,7 @@ class SessionPool:
         s.metrics = self._metrics
         s._stage_cache = self._arbiter.stage_cache
         s._data_cache = self._arbiter.result_cache
-        entry = _Entry(s)
+        entry = _Entry(s, name)
         if self._make_listener is not None:
             s.add_listener(self._make_listener(entry))
         return entry

@@ -32,8 +32,17 @@ Endpoints:
   query that already finished. Idempotent: a second DELETE of a
   still-stopping query is another 200.
 - ``GET /metrics``: the shared metrics registry in Prometheus text
-  exposition (queries, admission, arbiter, compile/result caches).
+  exposition (queries, admission, arbiter, compile/result caches,
+  latency histograms with native ``_bucket``/``_sum``/``_count``).
 - ``GET /healthz``: liveness + pool/admission/arbiter/quota stats.
+- ``GET /status``: the status store's live health snapshot — queries
+  in flight and per-phase outcomes per session, admission queue
+  depth, arbiter lease occupancy, cache hit rates, p50/p95/p99 query
+  latency per phase and query class, SLO burn rate.
+- ``GET /status/timeseries``: the heartbeat-sampled ring time-series
+  behind the snapshot (``?series=a,b&limit=N`` to filter/trim).
+- ``GET /debug/bundle``: dump an on-demand flight-recorder diagnostic
+  bundle per pooled session; returns the bundle directory paths.
 
 Per-request deadline: ``POST /sql`` honors
 ``spark_tpu.execution.queryDeadlineMs`` from the request's ``conf``
@@ -61,8 +70,10 @@ from ..config import Conf
 from ..execution import lifecycle
 from ..expr import AnalysisError
 from ..observability import ListenerBus, MetricsRegistry, QueryListener
+from ..observability.flight_recorder import FlightRecorder
 from ..observability.listener import ServiceEvent
 from ..observability.sinks import json_default
+from ..observability.status_store import StatusStore
 from ..sql.lexer import ParseError
 from ..udf_worker import UdfError
 from .admission import (SESSION_MAX_CONCURRENT_KEY, AdmissionController,
@@ -152,8 +163,7 @@ class SqlService:
         self.pool = SessionPool(
             self.conf, self.metrics, self.arbiter,
             init_session=init_session,
-            make_listener=lambda entry: _StatusListener(entry,
-                                                        self.history))
+            make_listener=self._make_listener)
         self.admission = AdmissionController(
             int(self.conf.get(MAX_CONCURRENT_KEY)),
             int(self.conf.get(QUEUE_DEPTH_KEY)),
@@ -164,6 +174,16 @@ class SqlService:
         self.session_quota = SessionQuota(
             int(self.conf.get(SESSION_MAX_CONCURRENT_KEY)),
             metrics=self.metrics)
+        #: heartbeat-sampled engine-health store behind GET /status —
+        #: providers run OUTSIDE its lock (each takes its own), so the
+        #: status seat never extends any provider's critical section
+        self.status_store = StatusStore(self.conf, self.metrics, {
+            "admission": self.admission.stats,
+            "quota": self.session_quota.stats,
+            "arbiter": self.arbiter.stats,
+            "pool": lambda: {"sessions": len(self.pool)},
+            "udf": self._udf_stats,
+        })
         self._records: "OrderedDict[str, Dict]" = OrderedDict()
         self._records_lock = threading.Lock()
         #: cancel tokens of submitted/running queries, by service query
@@ -193,6 +213,24 @@ class SqlService:
         #: background compile-cache warm-start replay (start() spawns
         #: it AFTER the socket binds; stop() joins it bounded)
         self._warm_thread: Optional[threading.Thread] = None
+
+    def _make_listener(self, entry) -> QueryListener:
+        """Per-pooled-session listener wiring (runs in pool._create):
+        bind the status store's per-session feed, then hand back the
+        /queries status listener the pool registers."""
+        self.status_store.bind(entry.session, entry.name)
+        return _StatusListener(entry, self.history)
+
+    def _udf_stats(self) -> Dict:
+        """Status-store provider: live UDF workers across the pool
+        (GIL-atomic reads of each pool's `_live`; 0 when no session
+        has spawned a worker pool)."""
+        live = 0
+        for s in self.pool.sessions().values():
+            pool = getattr(s, "_udf_pool", None)
+            if pool is not None:
+                live += int(pool._live)
+        return {"workers_live": live}
 
     # -- service event stream ----------------------------------------------
 
@@ -727,6 +765,19 @@ class SqlService:
                 "arbiter": self.arbiter.stats()
                 if self._installed_arbiter else None}
 
+    def debug_bundles(self) -> Dict:
+        """On-demand flight-recorder dump, one bundle per pooled
+        session (the GET /debug/bundle seat)."""
+        bundles = []
+        for name, session in self.pool.sessions().items():
+            rec = FlightRecorder.of(session)
+            if rec is None:
+                continue
+            path = rec.dump("on_demand", extra={"session": name})
+            if path is not None:
+                bundles.append({"session": name, "path": path})
+        return {"bundles": bundles}
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "SqlService":
@@ -740,6 +791,7 @@ class SqlService:
         connection-refused. Queries racing the replay just compile as
         usual (the stage cache fills under them either way)."""
         self._ensure_arbiter()
+        self.status_store.start()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer(
             (str(self.conf.get(HOST_KEY)), int(self.conf.get(PORT_KEY))),
@@ -769,8 +821,10 @@ class SqlService:
             else self._httpd.server_address[1]
 
     def stop(self) -> None:
-        """Clean shutdown: stop accepting, close the socket, uninstall
-        the arbiter if this service installed it."""
+        """Clean shutdown: stop accepting, close the socket, join the
+        status-store heartbeat, uninstall the arbiter if this service
+        installed it."""
+        self.status_store.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -837,6 +891,24 @@ def _make_handler(service: SqlService):
             path, _, query = self.path.partition("?")
             if path == "/healthz":
                 self._send_json(200, service.health())
+            elif path == "/status":
+                self._send_json(200, service.status_store.snapshot())
+            elif path == "/status/timeseries":
+                qs = parse_qs(query)
+                names = None
+                if qs.get("series"):
+                    names = [s for s in qs["series"][0].split(",") if s]
+                try:
+                    limit = (int(qs["limit"][0])
+                             if qs.get("limit") else None)
+                except (TypeError, ValueError) as e:
+                    self._send_json(400, {"error": "BAD_REQUEST",
+                                          "message": str(e)[:200]})
+                    return
+                self._send_json(200, service.status_store.timeseries(
+                    names=names, limit=limit))
+            elif path == "/debug/bundle":
+                self._send_json(200, service.debug_bundles())
             elif path == "/metrics":
                 self._send_text(
                     200, service.metrics_text(),

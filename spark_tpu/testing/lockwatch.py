@@ -34,6 +34,18 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+#: the most recently ENTERED LockWatch (cleared by uninstall): the
+#: flight recorder's bundle dump reads it via `current_watch()` so
+#: crash bundles under test carry the observed lock report. Written
+#: only from test setup/teardown — no lock needed (GIL-atomic ref).
+_CURRENT: Optional["LockWatch"] = None
+
+
+def current_watch() -> Optional["LockWatch"]:
+    """The active LockWatch, if a test installed one (None in
+    production — lockwatch is opt-in and test-only)."""
+    return _CURRENT
+
 
 class _WatchedLock:
     """Recording proxy over a Lock/RLock: context-manager + explicit
@@ -185,6 +197,8 @@ class LockWatch:
             else _WatchedLock
         setattr(obj, attr, cls(self, lock_id, inner))
         self._installed.append((obj, attr, inner))
+        global _CURRENT
+        _CURRENT = self
 
     def install_service(self, svc) -> None:
         """Wrap a SqlService's locks + the process device cache + every
@@ -206,6 +220,7 @@ class LockWatch:
         self.watch_attr(svc.metrics, "_lock", "metrics.registry")
         self.watch_attr(svc.metrics, "_flush_lock", "metrics.flush")
         self.watch_attr(svc.bus, "_lock", "obs.bus")
+        self.watch_attr(svc.status_store, "_lock", "obs.status")
         self.watch_attr(CACHE, "_lock", "io.device_cache")
         for entry in svc.pool._entries.values():
             self.watch_attr(entry, "lock", "service.session")
@@ -214,6 +229,7 @@ class LockWatch:
     def install_session(self, session) -> None:
         """Wrap one session's bus + built-in listener locks (+ its
         metrics registry when not the service-shared one)."""
+        from ..observability.flight_recorder import FlightRecorder
         from ..observability.sinks import EventLogListener
         from ..observability.straggler import StragglerMonitor
         self.watch_attr(session.listeners, "_lock", "obs.bus")
@@ -225,6 +241,8 @@ class LockWatch:
                 self.watch_attr(li, "_write_lock", "obs.event_log")
             elif isinstance(li, StragglerMonitor):
                 self.watch_attr(li, "_lock", "obs.straggler")
+            elif isinstance(li, FlightRecorder):
+                self.watch_attr(li, "_lock", "obs.flightrec")
 
     def install_faults(self) -> None:
         """Wrap the currently-armed fault plan's counter lock (call
@@ -236,9 +254,12 @@ class LockWatch:
 
     def uninstall(self) -> None:
         """Restore every wrapped attribute (reverse order)."""
+        global _CURRENT
         for obj, attr, inner in reversed(self._installed):
             setattr(obj, attr, inner)
         self._installed.clear()
+        if _CURRENT is self:
+            _CURRENT = None
 
     def __enter__(self):
         return self
