@@ -761,7 +761,8 @@ def obs_conf_on(base_dir: str) -> dict:
             "spark_tpu.sql.observability.shardSpans": "on",
             "spark_tpu.sql.status.enabled": "true",
             "spark_tpu.sql.flightRecorder.enabled": "true",
-            "spark_tpu.sql.flightRecorder.dir": base_dir + "/fr"}
+            "spark_tpu.sql.flightRecorder.dir": base_dir + "/fr",
+            "spark_tpu.sql.planChangeValidation": "full"}
 
 
 OBS_CONF_OFF = {"spark_tpu.sql.eventLog.dir": "",
@@ -770,7 +771,8 @@ OBS_CONF_OFF = {"spark_tpu.sql.eventLog.dir": "",
                 "spark_tpu.sql.observability.xlaCost": "off",
                 "spark_tpu.sql.observability.shardSpans": "off",
                 "spark_tpu.sql.status.enabled": "false",
-                "spark_tpu.sql.flightRecorder.enabled": "false"}
+                "spark_tpu.sql.flightRecorder.enabled": "false",
+                "spark_tpu.sql.planChangeValidation": "off"}
 
 
 def measure_obs_overhead(spark, run, base_dir: str, best_of: int = 3

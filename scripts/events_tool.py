@@ -8,12 +8,13 @@ enforceable in CI:
 
     scripts/events_tool.py validate <file-or-dir> [...]
         Validate every app-*.jsonl line against the versioned schema.
-        Knows every published schema_version (1..6): v3 added the
+        Knows every published schema_version (1..7): v3 added the
         per-shard `shards` records, `plan_tree` and `predictions`;
         v4 added the per-micro-batch `streaming` record; v5 added the
         per-query `udf` record (worker-lane batch/row totals); v6
         added the per-tick `trigger` record (supervised streaming
-        trigger loop) — purely additive, so old logs must (and do)
+        trigger loop); v7 added the per-(batch, rule) `rule_trace`
+        optimizer records — purely additive, so old logs must (and do)
         validate under their own version's rules. Exits nonzero listing file:line: problem for
         every violation.
 
@@ -39,7 +40,7 @@ import json
 import os
 import sys
 
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 #: per-micro-batch streaming record contract (schema v4):
 #: field -> allowed types
@@ -83,6 +84,18 @@ _TRIGGER_FIELDS = {
     "source": (str,),
     "reconnects": (int,),
 }
+
+#: per-(batch, rule) optimizer-trace record contract (schema v7):
+#: field -> allowed types; `diff` (first effective before/after tree
+#: diff) rides only when spark_tpu.sql.planChangeLog is on
+_RULE_TRACE_FIELDS = {
+    "batch": (str,),
+    "rule": (str,),
+    "invocations": (int,),
+    "effective": (int,),
+    "ms": (int, float),
+}
+
 
 #: per-shard record contract (schema v3): field -> allowed types
 #: (shard None marks host-side ingest records)
@@ -158,6 +171,9 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
     if ver < 6 and "trigger" in e:
         _problem(out, path, lineno,
                  f"schema v{ver} record carries v6 field 'trigger'")
+    if ver < 7 and "rule_trace" in e:
+        _problem(out, path, lineno,
+                 f"schema v{ver} record carries v7 field 'rule_trace'")
     if ver < 3:
         return
     reorder = e.get("reorder")
@@ -241,6 +257,32 @@ def validate_event(e: dict, path: str, lineno: int, out: list) -> None:
             if bad is not None:
                 _problem(out, path, lineno,
                          f"malformed trigger record ({bad}): {t!r}")
+    if ver >= 7:
+        rt = e.get("rule_trace")
+        if rt is not None and not isinstance(rt, list):
+            _problem(out, path, lineno,
+                     f"rule_trace must be a list: {rt!r}")
+        else:
+            for rec in rt or []:
+                bad = None
+                if not isinstance(rec, dict):
+                    bad = "not a dict"
+                else:
+                    for field, types in _RULE_TRACE_FIELDS.items():
+                        if not isinstance(rec.get(field), types):
+                            bad = f"field {field!r} not {types}"
+                            break
+                    if bad is None and rec["effective"] > \
+                            rec["invocations"]:
+                        bad = "effective exceeds invocations"
+                    if bad is None and "diff" in rec \
+                            and not isinstance(rec["diff"], str):
+                        bad = "field 'diff' not a string"
+                if bad is not None:
+                    _problem(out, path, lineno,
+                             f"malformed rule_trace record ({bad}): "
+                             f"{rec!r}")
+                    break
 
 
 def _log_files(targets):

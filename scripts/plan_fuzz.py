@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Differential optimizer fuzz campaign CLI.
+
+Runs `spark_tpu.testing.plan_fuzz` seeds: each seed generates random
+tables + a random query, executes it optimizer-off vs optimizer-on
+(under planChangeValidation=full) and per-rule-ablated, and asserts
+byte-identical results, zero integrity findings, and stable stage
+keys across repeated planning.
+
+Usage:
+    python scripts/plan_fuzz.py --seeds 500
+    python scripts/plan_fuzz.py --seeds 64 --ablate one
+    python scripts/plan_fuzz.py --start 1000 --seeds 100 --stop-on-fail
+
+Exits nonzero if any seed fails; failing seeds replay exactly with
+`run_seed(session, <seed>)`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="number of seeds (default: conf "
+                         "spark_tpu.sql.fuzz.seeds)")
+    ap.add_argument("--start", type=int, default=0,
+                    help="first seed (default 0)")
+    ap.add_argument("--ablate", default="effective",
+                    choices=("none", "one", "effective", "all"),
+                    help="rule-ablation mode (default: effective — "
+                         "ablate each rule that fired)")
+    ap.add_argument("--max-rows", type=int, default=None,
+                    help="max rows per generated table (default: conf "
+                         "spark_tpu.sql.fuzz.maxRows)")
+    ap.add_argument("--stop-on-fail", action="store_true",
+                    help="abort the campaign at the first failing seed")
+    args = ap.parse_args(argv)
+
+    from spark_tpu.session import SparkTpuSession
+    from spark_tpu.testing import plan_fuzz
+
+    session = SparkTpuSession.builder().get_or_create()
+    n = args.seeds if args.seeds is not None else \
+        int(session.conf.get(plan_fuzz.SEEDS_KEY))
+    seeds = range(args.start, args.start + n)
+
+    t0 = time.time()
+    done = [0]
+
+    def progress(seed, ok):
+        done[0] += 1
+        if done[0] % 50 == 0:
+            print(f"  ... {done[0]}/{n} seeds "
+                  f"({time.time() - t0:.1f}s)", flush=True)
+
+    res = plan_fuzz.run_campaign(session, seeds, ablate=args.ablate,
+                                 max_rows=args.max_rows,
+                                 stop_on_fail=args.stop_on_fail,
+                                 progress=progress)
+    dt = time.time() - t0
+    print(f"plan-fuzz: {len(res['ok'])}/{n} seeds clean in {dt:.1f}s "
+          f"(seeds {args.start}..{args.start + n - 1}, "
+          f"ablate={args.ablate})")
+    if res["effective_counts"]:
+        print("effective-rule coverage:")
+        for rule, cnt in sorted(res["effective_counts"].items(),
+                                key=lambda kv: -kv[1]):
+            print(f"  {rule}: {cnt}")
+    if res["failures"]:
+        print(f"\n{len(res['failures'])} FAILING seed(s):",
+              file=sys.stderr)
+        for seed, err in res["failures"]:
+            print(f"  seed {seed}: {err}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

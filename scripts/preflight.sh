@@ -4,7 +4,7 @@
 # Any stage failing exits this script NONZERO (set -e + explicit rc
 # checks), enforcing the ROADMAP pre-snapshot gate.
 #
-# Seventeen stages, all mandatory:
+# Eighteen stages, all mandatory:
 #   1. full tier-1 pytest suite (virtual 8-device CPU mesh via conftest)
 #   2. dryrun_multichip(8): jit + run the distributed collectives path
 #      end-to-end with single-chip parity checks
@@ -104,9 +104,18 @@
 #      exactly when a query dies. (The ≤10% observability-overhead
 #      gate in stage 5 already measures with the status store and
 #      flight recorder ON: bench.py's obs_conf_on includes both.)
+#  18. plan-integrity smoke: the rule-registry lint (RL100, part of
+#      stage 6's scripts/lint.py --all) green, a 64-seed differential
+#      fuzz campaign (scripts/plan_fuzz.py: optimizer-on vs -off under
+#      planChangeValidation=full plus one rule ablation per seed, all
+#      byte-identical with stable stage keys), and TPC-H Q3 under
+#      validation=full at golden parity with the schema-v7 rule_trace
+#      record present in the event log. (The stage-5 overhead gate
+#      already measures validation=full in obs_conf_on, so the
+#      verifier itself is held to the ≤10% budget.)
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-17 still run) for
+#   --fast skips the full pytest suite (stages 2-18 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -119,7 +128,7 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/17: tier-1 test suite --"
+    echo "-- stage 1/18: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
     timeout -k 10 870 env JAX_PLATFORMS=cpu \
@@ -133,16 +142,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/17: SKIPPED (--fast) --"
+    echo "-- stage 1/18: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/17: dryrun_multichip(8) --"
+echo "-- stage 2/18: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/17: bench smoke --"
+echo "-- stage 3/18: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -174,7 +183,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/17: chaos smoke --"
+echo "-- stage 4/18: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -228,7 +237,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/17: observability + analysis smoke --"
+echo "-- stage 5/18: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -321,10 +330,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/17: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/18: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/17: SQL service smoke --"
+echo "-- stage 7/18: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -398,7 +407,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/17: join-kernel + ingest parity smoke --"
+echo "-- stage 8/18: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -456,7 +465,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/17: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/18: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -500,7 +509,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/17: elastic mesh smoke --"
+echo "-- stage 10/18: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -550,7 +559,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/17: streaming durability smoke --"
+echo "-- stage 11/18: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -643,7 +652,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/17: concurrency smoke --"
+echo "-- stage 12/18: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -726,7 +735,7 @@ print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
 
-echo "-- stage 13/17: compile-cache smoke --"
+echo "-- stage 13/18: compile-cache smoke --"
 # Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
 # subprocess over the same dir must open warm (disk_hits >= 1, ZERO
 # disk misses = no backend recompiles of cached shapes) with
@@ -823,7 +832,7 @@ print(json.dumps({"preflight_compile_cache_smoke": "ok",
                   "corrupt_recovered": fixed["corrupt"]}))
 EOF11
 
-echo "-- stage 14/17: query-lifecycle cancellation smoke --"
+echo "-- stage 14/18: query-lifecycle cancellation smoke --"
 # Start a chunked Q3 via the service, DELETE it mid-stream, assert the
 # structured error + no thread leak + arbiter drained + an immediate
 # clean re-run at golden parity (the cancellation hard guarantee).
@@ -919,7 +928,7 @@ print(json.dumps({"preflight_cancellation_smoke": "ok",
                   "cancel_latency_s": round(latency_s, 3)}))
 EOF12
 
-echo "-- stage 15/17: python-UDF worker pool smoke --"
+echo "-- stage 15/18: python-UDF worker pool smoke --"
 # Worker-lane parity with in-process, an injected SIGKILL mid-batch
 # replaying exactly one batch, and the zero-leaked-children contract.
 env JAX_PLATFORMS=cpu python - <<'EOF13'
@@ -984,7 +993,7 @@ print(json.dumps({
     "workers_spawned": len(s._udf_pool.child_procs())}))
 EOF13
 
-echo "-- stage 16/17: unattended streaming smoke --"
+echo "-- stage 16/18: unattended streaming smoke --"
 # Socket producer under the supervised trigger loop: a mid-stream
 # connection kill must reconnect exactly once with zero loss, an
 # injected trigger_tick fatal must park the query in structured FAILED,
@@ -1094,7 +1103,7 @@ print(json.dumps({
     "groups": int(len(got))}))
 EOF14
 
-echo "-- stage 17/17: status store + flight recorder smoke --"
+echo "-- stage 17/18: status store + flight recorder smoke --"
 # Live /status must parse with latency percentiles after one query,
 # /status/timeseries must carry heartbeat-sampled series, and an
 # injected stage_run fatal must leave a flight-recorder bundle whose
@@ -1202,5 +1211,54 @@ print(json.dumps({"preflight_status_smoke": "ok",
                   "series": len(ts["series"]),
                   "bundle": os.path.basename(b)}))
 EOF15
+
+echo "-- stage 18/18: plan-integrity smoke --"
+# (a) 64-seed differential fuzz: optimizer-on vs -off (full validation)
+# plus one rule ablation per seed — byte parity, zero integrity
+# findings, stable stage keys (the RL100 rule-registry lint already
+# gated green inside stage 6's scripts/lint.py --all)
+env JAX_PLATFORMS=cpu python scripts/plan_fuzz.py --seeds 64 --ablate one
+
+# (b) TPC-H Q3 under planChangeValidation=full: golden parity must
+# hold with the verifier on, and the executed query's event-log line
+# must carry the schema-v7 rule_trace record with >=1 effective rule
+env JAX_PLATFORMS=cpu python - <<'EOF16'
+import json
+import tempfile
+
+from spark_tpu import SparkTpuSession, history
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import queries as Q
+from spark_tpu.tpch.datagen import write_parquet
+
+spark = SparkTpuSession.builder().get_or_create()
+base = tempfile.mkdtemp(prefix="preflight_plan_integrity_")
+spark.conf.set("spark_tpu.sql.eventLog.dir", base + "/events")
+spark.conf.set("spark_tpu.sql.planChangeValidation", "full")
+path = base + "/sf"
+write_parquet(path, 0.001)
+Q.register_tables(spark, path)
+qe = Q.QUERIES["q3"](spark)._qe()
+got = G.normalize_decimals(qe.collect().to_pandas())
+G.compare(got.reset_index(drop=True), G.GOLDEN["q3"](path))
+assert qe.rule_trace, "no rule_trace recorded under validation=full"
+effective = sum(r["effective"] for r in qe.rule_trace)
+assert effective >= 1, qe.rule_trace
+spark.conf.set("spark_tpu.sql.eventLog.dir", "")
+events = history.read_event_log(base + "/events")
+traces = [t for t in events.get("rule_trace", []) if isinstance(t, list)]
+assert traces and traces[-1], "event log carries no rule_trace record"
+rr = history.rule_report(events)
+assert len(rr) >= 1 and (rr["effective"] >= 1).any(), rr
+with open("/tmp/_preflight_pi_dir", "w") as f:
+    f.write(base + "/events")
+print(json.dumps({"preflight_plan_integrity_smoke": "ok",
+                  "effective_rules": int(effective),
+                  "trace_records": len(qe.rule_trace)}))
+EOF16
+
+# the v7 rule_trace lines validate against the versioned schema
+env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
+    "$(cat /tmp/_preflight_pi_dir)"
 
 echo "== preflight PASSED =="

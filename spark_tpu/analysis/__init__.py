@@ -24,8 +24,11 @@ from .findings import (AnalysisFindingError, CATEGORIES, FINDING_CODES,
                        Finding, errors_of)
 from .jaxpr_analyzer import analyze_jaxpr, trace_stage
 from .plan_analyzer import analyze_plan
+from .plan_integrity import (PlanChangeTracer, PlanIntegrityError,
+                             PlanIntegrityValidator)
 
 __all__ = [
     "AnalysisFindingError", "CATEGORIES", "FINDING_CODES", "Finding",
+    "PlanChangeTracer", "PlanIntegrityError", "PlanIntegrityValidator",
     "analyze_jaxpr", "analyze_plan", "errors_of", "trace_stage",
 ]

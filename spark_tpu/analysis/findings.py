@@ -35,9 +35,10 @@ CAT_RECOMPILE = "recompile"
 CAT_MESH = "mesh"
 CAT_X64 = "x64"
 CAT_KERNEL = "kernel"
+CAT_PLAN = "plan-integrity"
 
 CATEGORIES = (CAT_OVERFLOW, CAT_HOST_SYNC, CAT_RECOMPILE, CAT_MESH,
-              CAT_X64, CAT_KERNEL)
+              CAT_X64, CAT_KERNEL, CAT_PLAN)
 
 #: finding code -> (category, severity, one-line doc). The registry is
 #: closed on purpose: an ad-hoc code would dodge the README table and
@@ -114,6 +115,16 @@ FINDING_CODES: Dict[str, tuple] = {
         CAT_X64, "warn",
         "the traced stage reduces into an int32 accumulator with JAX "
         "x64 disabled: sums wrap at 2^31"),
+    "PLAN_INTEGRITY": (
+        CAT_PLAN, "error",
+        "an optimizer rule application broke a plan invariant "
+        "(unresolvable/ambiguous column reference, undeclared output-"
+        "schema change, duplicate output names, incoherent aggregate, "
+        "incompatible join-key dtypes, or a nondeterministic batch "
+        "rewrite) — the rewritten plan can return wrong results; "
+        "produced by analysis/plan_integrity.py under "
+        "spark_tpu.sql.planChangeValidation=lite (full raises "
+        "PlanIntegrityError instead)"),
 }
 
 

@@ -28,6 +28,10 @@ Built-in passes (lints/passes.py):
 - ``readme-metrics``: every registered METRIC_PREFIXES entry appears
   in the README metric-name reference table (the operator-facing half
   of the metric-prefix registration discipline).
+- ``rule-registry``: every optimizer `Rule` subclass carries a unique
+  `name`, is reachable from `default_optimizer()`, and declares
+  `schema_preserving` explicitly — the plan-integrity verifier's
+  rule contract (RL100).
 
 Concurrency passes (analysis/concurrency/lint_passes.py):
 

@@ -261,6 +261,99 @@ class ReadmeMetricsPass(LintPass):
 
 
 # ---------------------------------------------------------------------------
+# rule-registry
+# ---------------------------------------------------------------------------
+
+
+@register_lint
+class RuleRegistryPass(LintPass):
+    """Optimizer-rule registration discipline, enforced at the class
+    level: every `Rule` subclass in the engine (1) carries a unique
+    `name` (rule traces, `excludedRules` ablation and
+    `PlanIntegrityError` attribution all key on it), (2) is reachable
+    from `default_optimizer()` (an orphaned rule is dead code the
+    fuzzer can never ablate), and (3) declares `schema_preserving`
+    explicitly in its own body — the plan-integrity verifier holds
+    undeclared rules to the preservation contract, so an implicit
+    inheritance is a latent false positive/negative."""
+
+    name = "rule-registry"
+    code = "RL100"
+    doc = "Rule subclasses: unique name, reachable, explicit " \
+          "schema_preserving"
+
+    def scope(self, relpath: str) -> bool:
+        return False  # whole-tree pass: finish() imports the registry
+
+    def check(self, tree, relpath, ctx: LintContext):
+        return []
+
+    def _subclasses(self, base) -> list:
+        out = []
+        for cls in base.__subclasses__():
+            out.append(cls)
+            out.extend(self._subclasses(cls))
+        return out
+
+    def finish(self, ctx: LintContext):
+        import inspect
+        import os
+        from ...plan import join_reorder  # noqa: F401 — registers rules
+        from ...plan import optimizer
+        from ...plan.rules import Rule
+
+        def site(cls) -> Tuple[str, int]:
+            try:
+                relpath = os.path.relpath(inspect.getsourcefile(cls),
+                                          ctx.repo)
+                line = inspect.getsourcelines(cls)[1]
+            except (OSError, TypeError):
+                relpath, line = "spark_tpu/plan/rules.py", 1
+            return relpath, line
+
+        engine_rules = [cls for cls in self._subclasses(Rule)
+                        if cls.__module__.startswith("spark_tpu.")]
+        reachable = {type(r)
+                     for b in optimizer.default_optimizer().batches
+                     for r in b.rules}
+        out = []
+        by_name: dict = {}
+        for cls in engine_rules:
+            relpath, line = site(cls)
+            rname = cls.__dict__.get("name")
+            if not rname:
+                out.append((relpath, line,
+                            f"Rule subclass {cls.__name__} has no "
+                            f"`name` of its own (traces/ablation/"
+                            f"integrity errors key on it)"))
+            elif rname in by_name:
+                out.append((relpath, line,
+                            f"duplicate rule name {rname!r} (also "
+                            f"{by_name[rname].__name__}): excludedRules "
+                            f"and rule traces cannot distinguish them"))
+            else:
+                by_name[rname] = cls
+            if cls not in reachable:
+                out.append((relpath, line,
+                            f"rule {cls.__name__} is not reachable "
+                            f"from default_optimizer(): dead rule the "
+                            f"fuzzer can never ablate"))
+            if not isinstance(cls.__dict__.get("schema_preserving"),
+                              bool):
+                out.append((relpath, line,
+                            f"rule {cls.__name__} does not declare "
+                            f"`schema_preserving` in its own body; the "
+                            f"plan-integrity verifier needs the "
+                            f"explicit contract (True = must preserve "
+                            f"the root schema, False = legitimately "
+                            f"reshapes)"))
+        ctx.notes.append(
+            f"rule-registry: {len(engine_rules)} engine rule(s), "
+            f"{len(reachable)} reachable from default_optimizer")
+        return out
+
+
+# ---------------------------------------------------------------------------
 # tracer-leak
 # ---------------------------------------------------------------------------
 

@@ -344,6 +344,11 @@ def apply_code_remap(codes, remap):
     """Gather new codes through a remap table (identity when remap is None)."""
     if remap is None:
         return codes
+    if remap.shape[0] == 0:
+        # all-null column: the dictionary (and thus the remap) is
+        # empty, no code is valid and validity masks every row — any
+        # constant code works
+        return jnp.zeros_like(codes)
     return jnp.take(remap, jnp.clip(codes, 0, remap.shape[0] - 1))
 
 

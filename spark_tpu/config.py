@@ -10,6 +10,7 @@ overlaid by a per-session mutable map that is runtime-settable.
 
 from __future__ import annotations
 
+import os as _os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -683,6 +684,55 @@ ANALYSIS_JAXPR = register(
         "metrics.sink) or analysis.strict is on; 'on' always; 'off' "
         "never.",
     validator=lambda v: v in ("auto", "on", "off"))
+
+PLAN_VALIDATION = register(
+    "spark_tpu.sql.planChangeValidation", _os.environ.get(
+        "SPARK_TPU_PLAN_VALIDATION", "off"),
+    doc="Verify plan integrity after every effective optimizer-rule "
+        "application (analysis/plan_integrity.py; the reference's "
+        "spark.sql.planChangeValidation + LogicalPlanIntegrity): "
+        "column-reference resolution with unique origins, output-schema "
+        "preservation against the Rule.schema_preserving contract, "
+        "duplicate output names, aggregate coherence, join-key dtype "
+        "compatibility, and per-batch determinism (a replay over a "
+        "cloned input must reproduce the plan). 'full' raises a typed "
+        "PlanIntegrityError naming the rule/batch/node; 'lite' surfaces "
+        "PLAN_INTEGRITY findings through the analyzer flow instead; "
+        "'off' skips verification. The default honors the "
+        "SPARK_TPU_PLAN_VALIDATION environment variable (the test "
+        "suite pins it to 'full').",
+    validator=lambda v: v in ("off", "lite", "full"))
+
+PLAN_CHANGE_LOG = register(
+    "spark_tpu.sql.planChangeLog", False,
+    doc="Capture a unified before/after tree diff of each rule's first "
+        "effective application into the rule_trace records "
+        "(analysis/plan_integrity.py PlanChangeTracer; the reference's "
+        "spark.sql.planChangeLog.level). Off keeps rule_trace to "
+        "per-rule counters/timings only.")
+
+OPTIMIZER_EXCLUDED_RULES = register(
+    "spark_tpu.sql.optimizer.excludedRules", "",
+    doc="Comma-separated optimizer rule names to skip (the reference's "
+        "spark.sql.optimizer.excludedRules); '*' disables every rule. "
+        "The differential plan fuzzer (testing/plan_fuzz.py) uses this "
+        "as its optimizer-off baseline and per-rule ablation lever.")
+
+FUZZ_SEEDS = register(
+    "spark_tpu.sql.fuzz.seeds", 64,
+    doc="Default seed count for the differential plan fuzzer "
+        "(scripts/plan_fuzz.py): each seed generates one random "
+        "table set + query and runs it optimizer-on vs -off vs "
+        "per-rule-ablated.",
+    validator=lambda v: v > 0)
+
+FUZZ_MAX_ROWS = register(
+    "spark_tpu.sql.fuzz.maxRows", 40,
+    doc="Max rows per generated fuzz table (testing/plan_fuzz.py); "
+        "small tables keep the 500-seed CPU campaign tractable while "
+        "still covering nulls, NaN/-0.0 floats, decimals and "
+        "dictionary strings.",
+    validator=lambda v: v > 0)
 
 CHECKPOINT_DIR = register(
     "spark_tpu.sql.checkpoint.dir", "",

@@ -228,7 +228,7 @@ class DataFrame:
         return self.plan.schema().names
 
     def explain(self, extended: bool = False, runtime: bool = False,
-                analysis: bool = False) -> None:
+                analysis: bool = False, rules: bool = False) -> None:
         """Print the plan. runtime=True re-executes and annotates each
         operator with its output row count (SQLMetrics analog);
         analysis=True appends the pre-compile static analyzer's
@@ -237,11 +237,14 @@ class DataFrame:
         when the jaxpr half ran for that execution: always under
         `spark_tpu.sql.analysis.jaxpr=on`; under the default `auto`
         only when an observability output is configured or strict mode
-        is set."""
+        is set. rules=True appends the per-rule optimizer trace
+        (effectiveness counts; before/after diffs under
+        `spark_tpu.sql.planChangeLog`)."""
         qe = self._qe()
         if runtime:
             qe.execute_batch()
-        print(qe.explain(extended, runtime=runtime, analysis=analysis))
+        print(qe.explain(extended, runtime=runtime, analysis=analysis,
+                         rules=rules))
 
     # -- actions ------------------------------------------------------------
 

@@ -59,6 +59,14 @@ class QueryExecution:
         # cost-based join-reorder decisions (plan/join_reorder.py);
         # None until the optimizer ran for this execution
         self.reorder_decisions: Optional[list] = None
+        # per-(batch, rule) application records from the plan-change
+        # tracer (analysis/plan_integrity.py): the event-log rule_trace
+        # payload + explain(rules=True); None until the optimizer ran
+        self.rule_trace: Optional[list] = None
+        # lite-mode plan-integrity findings, merged into
+        # analysis_findings by _analyze_plan_phase (full mode raises
+        # PlanIntegrityError from inside the optimizer instead)
+        self._integrity_findings: list = []
         # set per execute_batch: False keeps event construction off the
         # hot path when nothing is listening
         self._observe_events = False
@@ -199,12 +207,24 @@ class QueryExecution:
             plan = self._apply_cache(self.analyzed)
             plan = self._resolve_scalar_subqueries(plan)
             log: list = []
+            from ..analysis.plan_integrity import (PlanChangeTracer,
+                                                   PlanIntegrityValidator)
+            mode = str(self._conf.get(
+                "spark_tpu.sql.planChangeValidation"))
+            validator = PlanIntegrityValidator(mode) \
+                if mode in ("lite", "full") else None
+            tracer = PlanChangeTracer(diffs=bool(self._conf.get(
+                "spark_tpu.sql.planChangeLog")))
             self._optimized = default_optimizer(
-                self._conf, reorder_log=log).execute(plan)
+                self._conf, reorder_log=log, validator=validator,
+                tracer=tracer).execute(plan)
             # cost-based join-reorder decisions (plan/join_reorder.py):
             # one record per eligible region, into the event log and
             # the explain()/history API "reorder: yes/no" annotation
             self.reorder_decisions = log
+            self.rule_trace = tracer.records
+            if validator is not None:
+                self._integrity_findings = validator.findings
             t1 = time.perf_counter()
             self.phase_times["optimization"] = t1 - t0
             self.spans.record("optimize", t0, t1)
@@ -223,7 +243,7 @@ class QueryExecution:
         return self._executed
 
     def explain(self, extended: bool = False, runtime: bool = False,
-                analysis: bool = False) -> str:
+                analysis: bool = False, rules: bool = False) -> str:
         out = []
         if extended:
             out += ["== Logical Plan ==", self.logical.tree_string(),
@@ -248,6 +268,14 @@ class QueryExecution:
             out += ["== Physical Plan ==",
                     self.executed_plan.tree_string()]
         out += ["== Join Reorder =="] + self._reorder_lines()
+        if rules:
+            # per-rule effectiveness trace from the plan-change tracer
+            # (optionally with before/after diffs under planChangeLog)
+            from ..analysis.plan_integrity import render_trace
+            self.optimized_plan  # ensure the optimizer (and tracer) ran
+            out.append("== Rule Trace ==")
+            out += render_trace(self.rule_trace or []) or \
+                ["  no rules applied"]
         if analysis:
             out.append("== Static Analysis ==")
             findings = self.analysis_findings
@@ -802,14 +830,23 @@ class QueryExecution:
             # leave None ("never analyzed"), NOT [] ("analyzed clean"):
             # explain(analysis=True) runs its on-demand walk off the
             # None sentinel, so a disabled execution can't print a
-            # false clean bill
-            self.analysis_findings = None
+            # false clean bill. Lite-mode plan-integrity findings still
+            # surface — validation ran regardless of the analyzer gate.
+            self.executed_plan  # ensure the optimizer (validator) ran
+            self.analysis_findings = \
+                list(self._integrity_findings) or None
+            if self.analysis_findings:
+                self._post_analysis(strict=False)
             return
         from ..analysis import analyze_plan
         t0 = time.perf_counter()
         mesh_n = max(1, int(self._conf.get("spark_tpu.sql.mesh.size")))
+        # lite-mode plan-integrity findings (collected while the
+        # optimizer ran, triggered via executed_plan below) join the
+        # analyzer's findings in the same flow
         self.analysis_findings = analyze_plan(self.executed_plan,
-                                              self._conf, mesh_n)
+                                              self._conf, mesh_n) \
+            + list(self._integrity_findings)
         self.spans.record("analyze", t0, time.perf_counter(),
                           findings=len(self.analysis_findings))
         if strict:
@@ -1862,6 +1899,12 @@ class QueryExecution:
                 "changed": any(d.get("changed")
                                for d in self.reorder_decisions),
                 "regions": list(self.reorder_decisions)}
+        if self.rule_trace:
+            # per-rule optimizer application records (schema v7,
+            # analysis/plan_integrity.py PlanChangeTracer): batch, rule,
+            # invocations, effective count, ms, optional first-effective
+            # tree diff — history.rule_report / GET /queries/<id>/plan
+            event["rule_trace"] = [dict(r) for r in self.rule_trace]
         if self.stage_costs:
             # per-stage XLA cost/memory accounting (history.hbm_summary
             # / compile_summary read these)

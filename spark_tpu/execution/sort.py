@@ -40,7 +40,12 @@ def sort_key_operand(vec: Vec, ascending: bool):
         if vec.dictionary is None:
             raise ValueError("sort on string requires dictionary")
         table = _rank_table(vec.dictionary)
-        data = jnp.take(table, jnp.clip(data, 0, len(table) - 1))
+        if len(table) == 0:
+            # all-null column: every row is masked by the null-rank
+            # operand, so any constant key works
+            data = jnp.zeros(data.shape, dtype=jnp.int32)
+        else:
+            data = jnp.take(table, jnp.clip(data, 0, len(table) - 1))
     if isinstance(vec.dtype, T.BooleanType):
         data = data.astype(jnp.int8)
     if not ascending:

@@ -876,7 +876,13 @@ class BinaryComparison(Expression):
         table = _dict_compare_table(colv.dictionary, value,
                                     self.op if colv is lv or type(self) in (EQ, NE)
                                     else _flip_op(self.op))
-        data = jnp.take(table, jnp.clip(colv.data, 0, len(table) - 1))
+        if len(table) == 0:
+            # all-null column: the dictionary is empty, so no code is
+            # valid and the payload is masked everywhere
+            data = jnp.zeros(colv.data.shape, dtype=bool)
+        else:
+            data = jnp.take(table,
+                            jnp.clip(colv.data, 0, len(table) - 1))
         return Vec(data, T.BOOLEAN, colv.validity)
 
     def _cmp(self, l, r):

@@ -42,7 +42,7 @@ def _log_paths(log_dir: str, app: Optional[str]) -> List[str]:
 #: event fields kept nested (object columns) rather than flattened
 _NESTED = ("spans", "stages", "shards", "predictions",
            "analysis_findings", "plan_tree", "reorder", "streaming",
-           "udf", "trigger")
+           "udf", "trigger", "rule_trace")
 
 
 def read_event_log(log_dir: str, app: Optional[str] = None) -> pd.DataFrame:
@@ -449,6 +449,40 @@ def prediction_report(events: pd.DataFrame) -> pd.DataFrame:
         if isinstance(finds, list):
             for f in finds:
                 rows.extend(_grade_finding(f, metrics, peak, base))
+    return pd.DataFrame(rows)
+
+
+def rule_report(events: pd.DataFrame) -> pd.DataFrame:
+    """Optimizer-rule activity over a replayed event log (schema v7
+    `rule_trace`): one row per (execution, batch, rule) that was
+    INVOKED, with invocation/effective counts, total rule ms, and the
+    execution's PLAN_INTEGRITY finding count — the replay surface for
+    'which rewrites actually fire, how often, at what cost, and did
+    the verifier ever object'."""
+    rows: List[dict] = []
+    if "rule_trace" not in events.columns:
+        return pd.DataFrame(rows)
+    for _, r in events.iterrows():
+        trace = r.get("rule_trace")
+        if not isinstance(trace, list):
+            continue
+        finds = r.get("analysis_findings") \
+            if "analysis_findings" in events.columns else None
+        integrity = sum(1 for f in finds or []
+                        if isinstance(f, dict)
+                        and f.get("code") == "PLAN_INTEGRITY") \
+            if isinstance(finds, list) else 0
+        base = {"ts": r.get("ts"), "app": r.get("app"),
+                "query_id": r.get("query_id"),
+                "integrity_findings": integrity}
+        for rec in trace:
+            if not isinstance(rec, dict):
+                continue
+            rows.append(dict(
+                base, batch=rec.get("batch"), rule=rec.get("rule"),
+                invocations=rec.get("invocations"),
+                effective=rec.get("effective"), ms=rec.get("ms"),
+                traced_diff="diff" in rec))
     return pd.DataFrame(rows)
 
 

@@ -50,7 +50,11 @@ def expr_to_arrow(e: Expression, schema: Optional[pa.Schema] = None):
         v = e.value
         if isinstance(e._dtype, T.DateType):
             import datetime
-            v = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+            # date literals carry either epoch days (SQL to_date path)
+            # or a datetime.date (F.lit(date) path)
+            if not isinstance(v, datetime.date):
+                v = datetime.date(1970, 1, 1) + \
+                    datetime.timedelta(days=int(v))
         return pa.scalar(v) if not isinstance(v, Expression) else None
     if isinstance(e, BinaryComparison):
         le, re = e.children

@@ -25,10 +25,13 @@ from .spans import to_chrome_trace
 # v5: the per-query `udf` record (lane mode, Arrow batch/row totals,
 # exec ms, worker restarts). v6: the per-tick `trigger` record from
 # the supervised streaming trigger loop (tick id, skew, batches run,
-# supervisor restarts, source kind, reconnects). Purely additive —
-# older logs replay unchanged (scripts/events_tool.py validates every
-# published version).
-EVENT_LOG_SCHEMA_VERSION = 6
+# supervisor restarts, source kind, reconnects). v7: the per-query
+# `rule_trace` record (per-(batch, rule) optimizer application
+# counters + optional before/after tree diffs from
+# analysis/plan_integrity.py). Purely additive — older logs replay
+# unchanged (scripts/events_tool.py validates every published
+# version).
+EVENT_LOG_SCHEMA_VERSION = 7
 
 
 def json_default(o):

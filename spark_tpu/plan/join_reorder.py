@@ -304,6 +304,9 @@ def _best_order(est: List[Tuple[int, float]],
 
 class CostBasedJoinReorder(Rule):
     name = "CostBasedJoinReorder"
+    # the restoring Project keeps names/dtypes but re-derives
+    # nullability from the reordered join tree
+    schema_preserving = False
 
     def __init__(self, conf=None, log: Optional[list] = None):
         self.conf = conf
@@ -336,7 +339,10 @@ class CostBasedJoinReorder(Rule):
         but a probe/build side flip (the capacity convention) altered
         the tree — without it a changed=true record whose order equals
         its relations list reads as a contradiction."""
-        if self.log is None:
+        from .rules import in_replay
+        if self.log is None or in_replay():
+            # the integrity validator's determinism replay re-applies
+            # this rule; its decisions must not double-append
             return
         labels = [self._rel_label(r) for r in region.rels]
         seq_changed = tuple(order) != tuple(range(len(labels)))

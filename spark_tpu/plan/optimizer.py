@@ -23,6 +23,7 @@ from .rules import Batch, Rule, RuleExecutor
 
 class CombineFilters(Rule):
     name = "CombineFilters"
+    schema_preserving = True
 
     def apply(self, plan):
         def f(node):
@@ -43,6 +44,7 @@ def _substitute(expr: Expression, mapping: dict) -> Expression:
 
 class PushFilterThroughProject(Rule):
     name = "PushFilterThroughProject"
+    schema_preserving = True
 
     def apply(self, plan):
         def f(node):
@@ -72,6 +74,7 @@ class PushFilterIntoScan(Rule):
     row-group pushdown)."""
 
     name = "PushFilterIntoScan"
+    schema_preserving = True
 
     def apply(self, plan):
         def f(node):
@@ -107,6 +110,9 @@ class PruneColumns(Rule):
     (reference: `ColumnPruning` + `V2ScanRelationPushDown` column pruning)."""
 
     name = "PruneColumns"
+    # narrows INTERIOR Scan/Join schemas; the root stays stable only
+    # when a Project/Aggregate caps the tree, so no blanket guarantee
+    schema_preserving = False
 
     def apply(self, plan):
         return self._prune(plan, None)
@@ -221,6 +227,9 @@ def _empty_batch():
 
 class ConstantFolding(Rule):
     name = "ConstantFolding"
+    # a folded Literal is non-null, so a nullable-typed constant
+    # expression legitimately tightens nullability at the root
+    schema_preserving = False
 
     def apply(self, plan):
         def fold_expr(e: Expression) -> Expression:
@@ -261,6 +270,9 @@ class CollapseProjectIntoAggregate(Rule):
     would miss (the sort path is ~30x slower at bench shapes)."""
 
     name = "CollapseProjectIntoAggregate"
+    # inlining projected expressions into the aggregate can tighten
+    # nullability (e.g. an aliased non-null arithmetic replacing a ref)
+    schema_preserving = False
 
     def apply(self, plan):
         def f(node):
@@ -312,6 +324,8 @@ class RewriteDistinctAggregates(Rule):
     raises)."""
 
     name = "RewriteDistinctAggregates"
+    # count(distinct) -> count over a dedupe changes result nullability
+    schema_preserving = False
 
     def apply(self, plan):
         from ..expr_agg import (AggExpr, Avg, AvgDistinct, Count,
@@ -365,6 +379,9 @@ class RewriteGroupKeyAggregates(Rule):
     factor only multiplies a non-null key."""
 
     name = "RewriteGroupKeyAggregates"
+    # sum/min/max/avg of a group key become post-aggregation arithmetic
+    # whose nullability follows the key, not the aggregate
+    schema_preserving = False
 
     def apply(self, plan):
         from ..expr import Cast, structurally_equal
@@ -403,6 +420,18 @@ class RewriteGroupKeyAggregates(Rule):
                 if isinstance(a.func, Avg) and isinstance(
                         a.func.child.dtype(child_schema), T.DecimalType):
                     continue  # avg(decimal) shifts scale; keep in agg
+                try:
+                    child_dt = a.func.child.dtype(child_schema)
+                except Exception:
+                    child_dt = None
+                if isinstance(child_dt, T.FractionalType):
+                    # -0.0 == 0.0 land in ONE group yet remain distinct
+                    # values, so the group's key representative is not
+                    # value-faithful: max(k) over {-0.0, 0.0} is 0.0
+                    # but the kept key may be -0.0 (and sum(k) != k*n).
+                    # Found by the differential plan fuzzer (seed class
+                    # 166/284/455); float keys keep the real aggregate.
+                    continue
                 g = match_group(node, a.func.child, child_schema)
                 if g is not None:
                     hits[a.out_name] = (a, g)
@@ -448,13 +477,30 @@ class RewriteGroupKeyAggregates(Rule):
         return plan.transform_up(f)
 
 
-def default_optimizer(conf=None, reorder_log=None) -> RuleExecutor:
-    """`conf` enables the conf-gated batches (cost-based join reorder);
-    without it the pipeline is the conf-independent rule set (rule unit
-    tests). `reorder_log` is a list the reorder rule appends decision
-    records to (the executor threads it into the event log)."""
+EXCLUDED_RULES_KEY = "spark_tpu.sql.optimizer.excludedRules"
+
+
+def excluded_rule_names(conf) -> Set[str]:
+    """Parse `spark_tpu.sql.optimizer.excludedRules` (comma-separated
+    rule names; `*` = every rule, i.e. optimizer off — the differential
+    fuzzer's baseline/ablation lever)."""
+    if conf is None:
+        return set()
+    raw = str(conf.get(EXCLUDED_RULES_KEY) or "")
+    return {s.strip() for s in raw.split(",") if s.strip()}
+
+
+def default_optimizer(conf=None, reorder_log=None, validator=None,
+                      tracer=None) -> RuleExecutor:
+    """`conf` enables the conf-gated batches (cost-based join reorder)
+    and the excludedRules ablation lever; without it the pipeline is the
+    conf-independent rule set (rule unit tests). `reorder_log` is a list
+    the reorder rule appends decision records to (the executor threads
+    it into the event log). `validator`/`tracer` are the plan-integrity
+    hooks (analysis/plan_integrity.py) installed by the executor from
+    `planChangeValidation` / `planChangeLog`."""
     from .join_reorder import CostBasedJoinReorder
-    return RuleExecutor([
+    batches = [
         Batch("Rewrite", [RewriteDistinctAggregates()], strategy="once"),
         Batch("Filter pushdown", [
             CombineFilters(),
@@ -469,4 +515,15 @@ def default_optimizer(conf=None, reorder_log=None) -> RuleExecutor:
         Batch("KeyAggs", [RewriteGroupKeyAggregates()], strategy="once"),
         Batch("Fold", [ConstantFolding()], strategy="once"),
         Batch("Prune", [PruneColumns()], strategy="once"),
-    ])
+    ]
+    excluded = excluded_rule_names(conf)
+    if excluded:
+        kept = []
+        for b in batches:
+            rules = [r for r in b.rules
+                     if "*" not in excluded and r.name not in excluded]
+            if rules:
+                kept.append(Batch(b.name, rules, b.strategy,
+                                  b.max_iterations))
+        batches = kept
+    return RuleExecutor(batches, validator=validator, tracer=tracer)

@@ -2,20 +2,46 @@
 
 Direct analog of the reference's `catalyst/rules/RuleExecutor.scala`
 (fixed-point vs once batches, per-rule effectiveness tracking a la
-`QueryPlanningTracker.scala:93`).
+`QueryPlanningTracker.scala:93`), plus the plan-integrity seam: an
+optional validator (per-effective-rule invariant checks + per-batch
+determinism replay, `analysis/plan_integrity.py`) and an optional
+tracer (the `PlanChangeLogger` analog feeding the event log's
+`rule_trace` record).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Sequence
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
 
 from .logical import LogicalPlan
+
+#: True while the integrity validator replays a batch for the
+#: determinism check. Rules with observable side channels (the join
+#: reorder decision log) must stay silent during a replay — otherwise
+#: the check itself would double-append their records. ContextVar, not
+#: a module global: service sessions optimize on concurrent threads.
+_IN_REPLAY: ContextVar[bool] = ContextVar(
+    "spark_tpu_rule_replay", default=False)
+
+
+def in_replay() -> bool:
+    return _IN_REPLAY.get()
 
 
 class Rule:
     name: str = "rule"
+
+    #: Plan-integrity contract: True = this rule keeps the ROOT output
+    #: schema (names/dtypes/nullability) byte-identical; False = the
+    #: rule legitimately reshapes output schemas and the verifier skips
+    #: the preservation check for it. None = undeclared — the verifier
+    #: holds undeclared rules to the preservation contract and lint
+    #: RL100 fails any concrete Rule subclass that doesn't declare
+    #: explicitly in its own class body.
+    schema_preserving: Optional[bool] = None
 
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         raise NotImplementedError
@@ -37,32 +63,78 @@ class RuleTiming:
 
 
 class RuleExecutor:
-    def __init__(self, batches: Sequence[Batch]):
+    def __init__(self, batches: Sequence[Batch], validator=None,
+                 tracer=None):
         self.batches = list(batches)
         self.timings: Dict[str, RuleTiming] = {}
+        #: analysis.plan_integrity.PlanIntegrityValidator (or None):
+        #: after_rule on every effective application, after_batch with a
+        #: replay closure for the determinism check
+        self.validator = validator
+        #: analysis.plan_integrity.PlanChangeTracer (or None)
+        self.tracer = tracer
+        #: did the batch currently being observed rewrite the plan?
+        self._batch_effective = False
 
     def execute(self, plan: LogicalPlan) -> LogicalPlan:
         for batch in self.batches:
-            iters = 1 if batch.strategy == "once" else batch.max_iterations
-            for _ in range(iters):
-                changed = False
-                for rule in batch.rules:
-                    t0 = time.perf_counter_ns()
-                    new_plan = rule.apply(plan)
+            batch_input = plan
+            plan = self._run_batch(batch, plan, observe=True)
+            # a no-op batch replays trivially — only batches with at
+            # least one effective application pay the determinism
+            # replay (an extra full batch run)
+            if self.validator is not None and self._batch_effective:
+                self.validator.after_batch(
+                    batch, batch_input, plan,
+                    lambda p, b=batch: self._replay_batch(b, p))
+        return plan
+
+    def _replay_batch(self, batch: Batch, plan: LogicalPlan
+                      ) -> LogicalPlan:
+        """Side-effect-free re-run for the determinism check: no
+        timings, no tracer/validator hooks, side channels silenced."""
+        token = _IN_REPLAY.set(True)
+        try:
+            return self._run_batch(batch, plan, observe=False)
+        finally:
+            _IN_REPLAY.reset(token)
+
+    def _run_batch(self, batch: Batch, plan: LogicalPlan,
+                   observe: bool) -> LogicalPlan:
+        iters = 1 if batch.strategy == "once" else batch.max_iterations
+        if observe:
+            self._batch_effective = False
+        for _ in range(iters):
+            changed = False
+            for rule in batch.rules:
+                t0 = time.perf_counter_ns()
+                new_plan = rule.apply(plan)
+                elapsed_ns = time.perf_counter_ns() - t0
+                effective = (new_plan is not plan
+                             and not new_plan.same_result(plan))
+                if observe:
                     t = self.timings.setdefault(rule.name, RuleTiming())
-                    t.total_ns += time.perf_counter_ns() - t0
+                    t.total_ns += elapsed_ns
                     t.invocations += 1
-                    if new_plan is not plan and not new_plan.same_result(plan):
+                    if effective:
                         t.effective += 1
-                        changed = True
-                        plan = new_plan
-                    else:
-                        plan = new_plan
-                if not changed:
-                    break
-            else:
-                if batch.strategy == "fixed_point":
-                    raise RuntimeError(
-                        f"batch {batch.name!r} did not converge in "
-                        f"{batch.max_iterations} iterations")
+                    if self.tracer is not None:
+                        self.tracer.after_rule(
+                            batch.name, rule, plan, new_plan, effective,
+                            elapsed_ns / 1e6)
+                    if effective and self.validator is not None:
+                        self.validator.after_rule(batch.name, rule,
+                                                  plan, new_plan)
+                if effective:
+                    changed = True
+                    if observe:
+                        self._batch_effective = True
+                plan = new_plan
+            if not changed:
+                break
+        else:
+            if batch.strategy == "fixed_point":
+                raise RuntimeError(
+                    f"batch {batch.name!r} did not converge in "
+                    f"{batch.max_iterations} iterations")
         return plan

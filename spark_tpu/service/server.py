@@ -709,7 +709,11 @@ class SqlService:
                 if reorder else None,
                 "reorder_regions": reorder.get("regions") or [],
                 "analysis_findings": detail.get("analysis_findings")
-                or []}
+                or [],
+                # per-rule optimizer application trace (schema v7):
+                # which rules fired, how often, and (under
+                # planChangeLog) the first effective tree diff
+                "rule_trace": detail.get("rule_trace") or []}
 
     def cancel_query(self, query_id: str):
         """Request cooperative cancellation of a submitted/running

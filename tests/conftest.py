@@ -15,11 +15,28 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8").strip()
 
+# The whole suite runs under FULL plan-change validation: every
+# effective optimizer-rule application in every test is invariant- and
+# determinism-checked (analysis/plan_integrity.py), so a bad rewrite
+# fails loudly at its source instead of as a wrong result downstream.
+# Registry DEFAULT (read by config.py at import, which is why this is
+# set before spark_tpu loads), not a conf override — the per-test
+# _session_conf_guard snapshot/restore leaves it alone, and a test
+# that explicitly sets planChangeValidation still wins.
+os.environ.setdefault("SPARK_TPU_PLAN_VALIDATION", "full")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 run "
+        "(-m 'not slow')")
 
 
 _FIXTURE_SESSIONS = []

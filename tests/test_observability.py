@@ -314,7 +314,7 @@ def test_event_log_schema_and_unique_filename(session, tmp_path):
     assert files[0] != f"app-{os.getpid()}.jsonl"
     line = json.loads(open(os.path.join(log_dir, files[0])).read()
                       .splitlines()[-1])
-    assert line["schema_version"] == 6
+    assert line["schema_version"] == 7
     assert line["status"] == "ok"
     assert line["query_id"] >= 1
 
@@ -462,7 +462,7 @@ def test_shard_telemetry_mesh_stream(session, tmp_path):
     assert not any(k.startswith("shard_") for k in qe.last_metrics)
     # event log: schema v3 `shards` replayed by the history views
     events = history.read_event_log(log_dir)
-    assert events.iloc[-1]["schema_version"] == 6
+    assert events.iloc[-1]["schema_version"] == 7
     ss = history.shard_summary(events)
     assert len(ss) == len(qe.spans.shard_records)
     rep = history.straggler_report(events)

@@ -1,0 +1,464 @@
+"""Plan-integrity verifier + plan-change tracer + differential fuzzer.
+
+Contract under test: every EFFECTIVE optimizer-rule application is
+invariant-checked (analysis/plan_integrity.py) — a deliberately broken
+rule is caught BY NAME in full mode, surfaces as PLAN_INTEGRITY
+findings in lite mode, and a nondeterministic rule trips the
+batch-replay determinism check. The tracer records one row per
+(batch, rule) and rides explain(rules=True) + the schema-v7
+`rule_trace` event record (events_tool validation + history
+rule_report). The differential fuzzer's pinned seeds and the two
+engine bugs the first campaign surfaced (date-literal scan pushdown,
+all-null dictionary columns) stay fixed.
+
+The whole tier-1 suite runs under planChangeValidation=full (conftest
+sets the registry default), so every other test doubles as a verifier
+no-false-positives check.
+"""
+
+import datetime
+import gc
+
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_tpu import functions as F
+from spark_tpu.analysis import (PlanChangeTracer, PlanIntegrityError,
+                                PlanIntegrityValidator)
+from spark_tpu.analysis.plan_integrity import check_plan, render_trace
+from spark_tpu.functions import col, lit
+from spark_tpu.plan import logical as L
+from spark_tpu.plan.optimizer import default_optimizer
+from spark_tpu.plan.rules import Batch, Rule, RuleExecutor
+
+VALIDATION_KEY = "spark_tpu.sql.planChangeValidation"
+CHANGE_LOG_KEY = "spark_tpu.sql.planChangeLog"
+EXCLUDED_KEY = "spark_tpu.sql.optimizer.excludedRules"
+
+
+@pytest.fixture()
+def pi_session(session):
+    session.register_table("pi_t", pa.table({
+        "a": pa.array([1, 2, 3, 4, None], pa.int64()),
+        "b": pa.array([10.0, -1.5, None, 0.25, 3.0], pa.float64()),
+        "c": pa.array(["x", "y", None, "x", "z"], pa.string())}))
+    return session
+
+
+def _mutant_cleanup(*classes):
+    """Hide test-local Rule subclasses from the rule-registry lint: the
+    pass only inspects classes whose __module__ lives under spark_tpu.,
+    so repointing the module is enough for any later full-tree pass in
+    this pytest process. (Reassigning __bases__ away from Rule is not
+    possible — CPython rejects it when deallocators differ.)"""
+    for cls in classes:
+        cls.__module__ = "tests.__dead_mutant__"
+    gc.collect()
+
+
+# ---------------------------------------------------------------------------
+# the verifier catches broken rules, by name
+# ---------------------------------------------------------------------------
+
+
+class TestVerifierCatchesMutants:
+    def _bad_prune(self):
+        class BadPrune(Rule):
+            name = "BadPrune"
+            schema_preserving = False
+
+            def apply(self, plan):
+                def f(node):
+                    if isinstance(node, L.Project) \
+                            and len(node.exprs) > 1:
+                        return L.Project(node.child, node.exprs[:1])
+                    return node
+                return plan.transform_up(f)
+        return BadPrune
+
+    def test_full_mode_names_the_rule(self, pi_session):
+        """The acceptance mutant: a rule that drops columns a parent
+        still references raises PlanIntegrityError carrying the rule,
+        batch and offending node."""
+        BadPrune = self._bad_prune()
+        try:
+            df = pi_session.table("pi_t") \
+                .select(col("a"), col("b")).filter(col("b") > lit(0.0))
+            ex = RuleExecutor([Batch("bad", [BadPrune()])],
+                              validator=PlanIntegrityValidator("full"))
+            with pytest.raises(PlanIntegrityError) as ei:
+                ex.execute(df.plan)
+            assert ei.value.rule == "BadPrune"
+            assert ei.value.batch == "bad"
+            assert ei.value.check == "resolution"
+            assert "'b'" in str(ei.value)
+        finally:
+            _mutant_cleanup(BadPrune)
+
+    def test_lite_mode_collects_findings(self, pi_session):
+        BadPrune = self._bad_prune()
+        try:
+            df = pi_session.table("pi_t") \
+                .select(col("a"), col("b")).filter(col("b") > lit(0.0))
+            v = PlanIntegrityValidator("lite")
+            RuleExecutor([Batch("bad", [BadPrune()])],
+                         validator=v).execute(df.plan)
+            assert v.findings, "lite mode swallowed the violation"
+            assert all(f.code == "PLAN_INTEGRITY" for f in v.findings)
+            assert v.findings[0].op == "BadPrune"
+            assert v.findings[0].detail["batch"] == "bad"
+        finally:
+            _mutant_cleanup(BadPrune)
+
+    def test_schema_preservation_contract(self, pi_session):
+        """A rule that reshapes the root schema WITHOUT declaring
+        schema_preserving=False is charged with the drift."""
+        class SilentReshape(Rule):
+            name = "SilentReshape"
+            schema_preserving = True  # lies
+
+            def apply(self, plan):
+                if isinstance(plan, L.Project):
+                    return L.Project(plan.child, plan.exprs[:1])
+                return plan
+        try:
+            df = pi_session.table("pi_t").select(col("a"), col("b"))
+            ex = RuleExecutor(
+                [Batch("reshape", [SilentReshape()], strategy="once")],
+                validator=PlanIntegrityValidator("full"))
+            with pytest.raises(PlanIntegrityError) as ei:
+                ex.execute(df.plan)
+            assert ei.value.rule == "SilentReshape"
+            assert ei.value.check == "schema-preservation"
+        finally:
+            _mutant_cleanup(SilentReshape)
+
+    def test_nondeterministic_rule_caught(self, pi_session):
+        """The batch-replay determinism check: a rule whose output
+        depends on call count produces a different plan on replay."""
+        class Jitter(Rule):
+            name = "Jitter"
+            schema_preserving = True
+
+            def __init__(self):
+                self.n = 0
+
+            def apply(self, plan):
+                self.n += 1
+                return L.Limit(plan, 100 + self.n)
+        try:
+            df = pi_session.table("pi_t").select(col("a"))
+            ex = RuleExecutor(
+                [Batch("jit", [Jitter()], strategy="once")],
+                validator=PlanIntegrityValidator("full"))
+            with pytest.raises(PlanIntegrityError) as ei:
+                ex.execute(df.plan)
+            assert ei.value.check == "determinism"
+            assert ei.value.batch == "jit"
+        finally:
+            _mutant_cleanup(Jitter)
+
+    def test_preexisting_violations_not_attributed(self, pi_session):
+        """`SELECT k, k`-style duplicate names are LEGAL user plans;
+        a rule that merely touches such a plan must not be blamed."""
+        df = pi_session.table("pi_t").select(col("a"), col("a")) \
+            .filter(col("a") > lit(0)).filter(col("a") < lit(10))
+        assert any(v["check"] == "duplicate-names"
+                   for v in check_plan(df.plan))
+        v = PlanIntegrityValidator("full")
+        # CombineFilters is effective here (two stacked filters)
+        out = default_optimizer(pi_session.conf, validator=v) \
+            .execute(df.plan)
+        assert out is not None  # no PlanIntegrityError raised
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: conf wiring, trace, explain, event log
+# ---------------------------------------------------------------------------
+
+
+class TestTraceAndConfWiring:
+    def test_full_validation_clean_query(self, pi_session):
+        pi_session.conf.set(VALIDATION_KEY, "full")
+        df = pi_session.table("pi_t").filter(col("a") > lit(1)) \
+            .group_by(col("c")).agg(F.avg(col("b")).alias("ab"))
+        qe = df._qe()
+        got = qe.collect()
+        assert got.num_rows >= 1
+        assert qe.rule_trace, "tracer recorded nothing"
+        rec = qe.rule_trace[0]
+        assert set(rec) >= {"batch", "rule", "invocations",
+                            "effective", "ms"}
+        assert sum(r["effective"] for r in qe.rule_trace) >= 1
+
+    def test_explain_rules_section(self, pi_session):
+        qe = pi_session.table("pi_t").filter(col("a") > lit(1))._qe()
+        text = qe.explain(rules=True)
+        assert "== Rule Trace ==" in text
+        assert "effective" in text
+
+    def test_change_log_diff(self, pi_session):
+        pi_session.conf.set(CHANGE_LOG_KEY, True)
+        df = pi_session.table("pi_t").filter(col("a") > lit(0)) \
+            .filter(col("a") < lit(9))
+        qe = df._qe()
+        qe.collect()
+        diffs = [r for r in qe.rule_trace if "diff" in r]
+        assert diffs, "planChangeLog recorded no diff"
+        assert any(ln.startswith(("-", "+"))
+                   for ln in diffs[0]["diff"].splitlines())
+        # render_trace indents the diff under the summary line
+        lines = render_trace(qe.rule_trace)
+        assert any("effective" in ln for ln in lines)
+
+    def test_excluded_rules_ablation(self, pi_session):
+        df = pi_session.table("pi_t").filter(col("a") > lit(0)) \
+            .filter(col("a") < lit(9))
+        pi_session.conf.set(EXCLUDED_KEY, "*")
+        qe_off = df._qe()
+        base = qe_off.collect().to_pandas()
+        assert not qe_off.rule_trace, "excludedRules=* still ran rules"
+        pi_session.conf.set(EXCLUDED_KEY, "CombineFilters")
+        qe_abl = df._qe()
+        got = qe_abl.collect().to_pandas()
+        assert all(r["rule"] != "CombineFilters"
+                   for r in qe_abl.rule_trace)
+        pd.testing.assert_frame_equal(
+            got.sort_values(list(got.columns)).reset_index(drop=True),
+            base.sort_values(list(base.columns)).reset_index(drop=True))
+
+    def test_rule_trace_rides_event_log(self, pi_session, tmp_path):
+        from spark_tpu import history
+        pi_session.conf.set("spark_tpu.sql.eventLog.dir", str(tmp_path))
+        pi_session.conf.set(VALIDATION_KEY, "full")
+        df = pi_session.table("pi_t").filter(col("a") > lit(0)) \
+            .filter(col("a") < lit(9))
+        df._qe().collect()
+        pi_session.conf.set("spark_tpu.sql.eventLog.dir", "")
+        events = history.read_event_log(str(tmp_path))
+        assert len(events) >= 1
+        trace = events.iloc[-1]["rule_trace"]
+        assert isinstance(trace, list) and trace
+        assert events.iloc[-1]["schema_version"] == 7
+        rr = history.rule_report(events)
+        assert {"batch", "rule", "invocations", "effective", "ms",
+                "integrity_findings"} <= set(rr.columns)
+        assert (rr["effective"] >= 1).any()
+
+
+# ---------------------------------------------------------------------------
+# events_tool v7 contract
+# ---------------------------------------------------------------------------
+
+
+def _event(**kw):
+    e = {"schema_version": 7, "ts": 1.0, "status": "ok",
+         "plan": "Scan", "query_id": 1}
+    e.update(kw)
+    return e
+
+
+class TestEventsToolV7:
+    def _validate(self, e):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "events_tool", os.path.join(os.path.dirname(__file__),
+                                        "..", "scripts",
+                                        "events_tool.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = []
+        mod.validate_event(e, "t.jsonl", 1, out)
+        return out
+
+    def test_valid_v7_trace(self):
+        assert self._validate(_event(rule_trace=[
+            {"batch": "Filter pushdown", "rule": "CombineFilters",
+             "invocations": 3, "effective": 1, "ms": 0.2,
+             "diff": "-a\n+b"}])) == []
+
+    def test_v6_carrying_rule_trace_rejected(self):
+        out = self._validate(_event(schema_version=6, rule_trace=[]))
+        assert any("v7 field 'rule_trace'" in p for p in out)
+
+    def test_malformed_fields(self):
+        out = self._validate(_event(rule_trace=[
+            {"batch": "b", "rule": "R", "invocations": 1,
+             "effective": 2, "ms": 0.1}]))
+        assert any("effective exceeds invocations" in p for p in out)
+        out = self._validate(_event(rule_trace=[
+            {"batch": "b", "rule": 7, "invocations": 1,
+             "effective": 0, "ms": 0.1}]))
+        assert any("field 'rule'" in p for p in out)
+        out = self._validate(_event(rule_trace={"not": "a list"}))
+        assert any("must be a list" in p for p in out)
+
+    def test_rule_report_counts_integrity_findings(self):
+        from spark_tpu import history
+        events = pd.DataFrame([{
+            "ts": 1.0, "app": "a", "query_id": 1,
+            "rule_trace": [{"batch": "b", "rule": "R",
+                            "invocations": 2, "effective": 1,
+                            "ms": 0.3}],
+            "analysis_findings": [{"code": "PLAN_INTEGRITY"},
+                                  {"code": "PLAN_INTEGRITY"},
+                                  {"code": "UDF_OPAQUE_PREDICATE"}]}])
+        rr = history.rule_report(events)
+        assert len(rr) == 1
+        assert rr.iloc[0]["integrity_findings"] == 2
+        assert rr.iloc[0]["rule"] == "R"
+        # a frame without the column degrades to empty, not a crash
+        assert history.rule_report(pd.DataFrame([{"ts": 1}])).empty
+
+
+# ---------------------------------------------------------------------------
+# rule-registry lint (RL100)
+# ---------------------------------------------------------------------------
+
+
+class TestRuleRegistryLint:
+    def test_real_tree_clean(self):
+        from spark_tpu.analysis.lints import run_passes
+        violations = [v for v in run_passes(["rule-registry"])
+                      if v.severity == "error"]
+        assert violations == [], [v.render() for v in violations]
+
+    def test_synthetic_violations_detected(self):
+        from spark_tpu.analysis.lints import LintContext
+        from spark_tpu.analysis.lints.passes import RuleRegistryPass
+
+        class Dup(Rule):
+            name = "CombineFilters"  # collides with the real rule
+        Dup.__module__ = "spark_tpu.__mutant__"
+        try:
+            out = RuleRegistryPass().finish(LintContext())
+            msgs = [m for _, _, m in out]
+            assert any("duplicate rule name 'CombineFilters'" in m
+                       for m in msgs)
+            assert any("Dup is not reachable" in m for m in msgs)
+            assert any("Dup does not declare `schema_preserving`" in m
+                       for m in msgs)
+        finally:
+            _mutant_cleanup(Dup)
+
+
+# ---------------------------------------------------------------------------
+# fuzzer: pinned seeds + minimized regressions from the first campaign
+# ---------------------------------------------------------------------------
+
+
+class TestFuzzRegressions:
+    def test_pinned_seeds(self, session):
+        """A handful of seeds through the full differential harness on
+        every tier-1 run (the 500-seed campaign is scripts/plan_fuzz.py
+        territory; seeds here keep the harness itself honest)."""
+        from spark_tpu.testing import plan_fuzz
+        for seed in (0, 1, 3):
+            res = plan_fuzz.run_seed(session, seed, ablate="one")
+            assert res["seed"] == seed
+
+    @pytest.mark.slow
+    def test_seed_sweep(self, session):
+        from spark_tpu.testing import plan_fuzz
+        res = plan_fuzz.run_campaign(session, range(40), ablate="one")
+        assert res["failures"] == [], res["failures"]
+
+    def test_canonical_bytes_total_order(self):
+        """-0.0 vs 0.0 distinguished; NaN payloads canonicalized;
+        row order irrelevant."""
+        from spark_tpu.testing.plan_fuzz import canonical_bytes
+        t1 = pa.table({"x": pa.array([0.0, 1.0])})
+        t2 = pa.table({"x": pa.array([-0.0, 1.0])})
+        t3 = pa.table({"x": pa.array([1.0, 0.0])})
+        assert canonical_bytes(t1) != canonical_bytes(t2)
+        assert canonical_bytes(t1) == canonical_bytes(t3)
+        nan = float("nan")
+        t4 = pa.table({"x": pa.array([nan, None])})
+        t5 = pa.table({"x": pa.array([None, nan])})
+        assert canonical_bytes(t4) == canonical_bytes(t5)
+
+    def test_date_literal_scan_pushdown(self, session):
+        """Campaign bug #1 (seeds 24/37 of the first run): pushing
+        `date_col >= lit(datetime.date)` into a scan crashed —
+        io/sources.py assumed date literals carry epoch days."""
+        session.register_table("pi_dates", pa.table({
+            "d": pa.array([datetime.date(2024, 1, 1),
+                           datetime.date(2025, 6, 15), None],
+                          pa.date32()),
+            "v": pa.array([1, 2, 3], pa.int64())}))
+        pivot = datetime.date(2025, 1, 1)
+        df = session.table("pi_dates").filter(col("d") >= lit(pivot))
+        session.conf.set(EXCLUDED_KEY, "*")
+        base = df._qe().collect().to_pandas()
+        session.conf.set(EXCLUDED_KEY, "")
+        got = df._qe().collect().to_pandas()
+        pd.testing.assert_frame_equal(got, base)
+        assert got["v"].tolist() == [2]
+
+    def test_all_null_string_column(self, session):
+        """Campaign bug #2 (seeds 37/76 of the first run): an all-null
+        string column has an EMPTY dictionary; comparing or sorting on
+        it did a jnp.take from an empty axis."""
+        session.register_table("pi_nulls", pa.table({
+            "s": pa.array([None, None, None], pa.string()),
+            "v": pa.array([3, 1, 2], pa.int64())}))
+        t = session.table("pi_nulls")
+        assert t.filter(col("s") == lit("x"))._qe() \
+            .collect().num_rows == 0
+        got = t.sort(col("s"), col("v"))._qe().collect()
+        assert got.column("v").to_pylist() == [1, 2, 3]
+
+    def test_all_null_string_unification(self, session):
+        """Campaign bug #3 (seeds 138/219/240 of the 500-seed run):
+        unifying a non-empty string dictionary with an all-null side
+        (union / join payload) built a ZERO-length remap table and
+        jnp.take'd from it (columnar.apply_code_remap)."""
+        session.register_table("pi_us_l", pa.table({
+            "k": pa.array([0, 1], pa.int32()),
+            "s": pa.array(["x", "y"], pa.string())}))
+        session.register_table("pi_us_r", pa.table({
+            "k": pa.array([0, 1], pa.int32()),
+            "s": pa.array([None, None], pa.string())}))
+        l, r = session.table("pi_us_l"), session.table("pi_us_r")
+        got = l.union(r).sort(col("s"), col("k"))._qe().collect()
+        assert got.column("s").to_pylist() == [None, None, "x", "y"]
+        j = l.join(r.select(col("k"), col("s").alias("s2")),
+                   on="k", how="inner")
+        out = j.sort(col("k"))._qe().collect()
+        assert out.column("s").to_pylist() == ["x", "y"]
+        assert out.column("s2").to_pylist() == [None, None]
+
+    def test_float_group_key_rewrite_negative_zero(self, session):
+        """Campaign bug #4 (seeds 166/284/455 of the 500-seed run):
+        RewriteGroupKeyAggregates substituted the group-key
+        representative for sum/min/max/avg(key) — but -0.0 == 0.0
+        land in ONE float group while remaining distinct values, so
+        max(d) over {-0.0, 0.0} is 0.0 while the kept key may be
+        -0.0 (and sum(d) != d * count(d)). The rule must skip
+        fractional keys; results must match the unoptimized plan
+        byte-for-byte."""
+        from spark_tpu.testing.plan_fuzz import canonical_bytes
+        session.register_table("pi_negz", pa.table({
+            "d": pa.array([0.0, -0.0, 5.0, None], pa.float64()),
+            "k": pa.array([1, 2, 3, 4], pa.int32())}))
+        df = session.table("pi_negz").group_by(col("d")).agg(
+            F.count("*").alias("c"), F.max(col("d")).alias("mx"),
+            F.sum(col("d")).alias("sm"))
+        session.conf.set(EXCLUDED_KEY, "*")
+        base = canonical_bytes(df._qe().collect())
+        session.conf.set(EXCLUDED_KEY, "")
+        qe = df._qe()
+        assert canonical_bytes(qe.collect()) == base
+        fired = [r["rule"] for r in qe.rule_trace
+                 if r["rule"] == "RewriteGroupKeyAggregates"
+                 and r["effective"]]
+        assert not fired, "rewrite must not fire on a float group key"
+        # guard against over-disabling: an integral key still rewrites
+        dfi = session.table("pi_negz").group_by(col("k")).agg(
+            F.count("*").alias("c"), F.sum(col("k")).alias("sk"))
+        qi = dfi._qe()
+        qi.collect()
+        assert any(r["rule"] == "RewriteGroupKeyAggregates"
+                   and r["effective"] for r in qi.rule_trace)

@@ -558,7 +558,7 @@ def test_concurrent_queries_scrape_and_rotation(service, tpch_path,
     events = H.read_event_log(ev_dir)
     assert len(events) == n_sessions * n_rounds
     assert (events["status"] == "ok").all()
-    assert (events["schema_version"] == 6).all()
+    assert (events["schema_version"] == 7).all()
     # the versioned-schema validator agrees line by line
     import importlib.util
     root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))

@@ -525,7 +525,7 @@ def test_history_status_summary_and_stats(session, tmp_path):
     text = "\n".join(lines)
     assert "records: 2" in text
     assert "ok=2" in text
-    assert "schema versions: v6=2" in text
+    assert "schema versions: v7=2" in text
     assert "time span:" in text
     assert tool.main(["stats", log_dir]) == 0
     # empty target still prints a sane summary

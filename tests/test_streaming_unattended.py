@@ -589,7 +589,7 @@ def test_trigger_event_log_v6_summary_and_validator(session, tmp_path):
     q.stop()
     session.conf.set("spark_tpu.sql.eventLog.dir", "")
     events = history.read_event_log(ev_dir)
-    assert (events["schema_version"].dropna() == 6).all()
+    assert (events["schema_version"].dropna() == 7).all()
     ss = history.streaming_summary(events)
     trig = ss[ss["record"] == "trigger"]
     assert len(trig) >= 2, ss

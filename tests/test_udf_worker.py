@@ -411,7 +411,7 @@ def test_event_log_udf_record_and_prediction_report(session, tmp_path):
     events = read_event_log(d)
     u = events.iloc[-1]["udf"]
     assert u["mode"] == "worker" and u["batches"] == 4 and u["rows"] == 10
-    assert events.iloc[-1]["schema_version"] == 6
+    assert events.iloc[-1]["schema_version"] == 7
     rep = prediction_report(events)
     udf_rows = rep[rep["kind"].isin(["udf_batches", "udf_rows"])] \
         if not rep.empty else rep
