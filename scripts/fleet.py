@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Run a crash-only SqlService serving fleet on this host.
+
+Thin launcher over spark_tpu/service/fleet.py: a supervisor process
+that owns the public port and routes to N SqlService worker
+subprocesses (session-affine consistent hashing, read failover,
+RetryPolicy restart ladder with flap-breaker quarantine). SIGTERM or
+SIGINT drains: new work sheds with 503 FLEET_DRAINING, in-flight
+queries finish under spark_tpu.service.fleet.drainTimeoutMs, workers
+exit 0, the supervisor follows.
+
+Usage:
+    scripts/fleet.py --workers 4 --port 8080 \
+        --conf spark_tpu.sql.compileCache.dir=/var/cache/sptpu \
+        --init myapp.serving:init_session
+
+Workers share the compile-cache dir, so a respawned worker opens hot
+(warm-start manifest replay instead of XLA recompiles).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from spark_tpu.service.fleet import _supervisor_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(_supervisor_main(sys.argv[1:]))

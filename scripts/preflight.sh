@@ -115,7 +115,7 @@
 #      verifier itself is held to the ≤10% budget.)
 #
 # Usage: scripts/preflight.sh [--fast]
-#   --fast skips the full pytest suite (stages 2-18 still run) for
+#   --fast skips the full pytest suite (stages 2-19 still run) for
 #   quick inner-loop checks; CI and end-of-round runs must use the
 #   default.
 
@@ -128,10 +128,12 @@ FAST=0
 echo "== preflight: $(date -u +%FT%TZ) =="
 
 if [ "$FAST" -eq 0 ]; then
-    echo "-- stage 1/18: tier-1 test suite --"
+    echo "-- stage 1/19: tier-1 test suite --"
     rm -f /tmp/_preflight_t1.log
     set +e  # keep control on pytest failure so the diagnostic prints
-    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    # budget sized for the grown suite (773 tests, ~15min on one CPU
+    # mesh) — the old 870s cap was tripping on wall clock, not failures
+    timeout -k 10 1500 env JAX_PLATFORMS=cpu \
         python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly 2>&1 | tee /tmp/_preflight_t1.log
@@ -142,16 +144,16 @@ if [ "$FAST" -eq 0 ]; then
         exit "$rc"
     fi
 else
-    echo "-- stage 1/18: SKIPPED (--fast) --"
+    echo "-- stage 1/19: SKIPPED (--fast) --"
 fi
 
-echo "-- stage 2/18: dryrun_multichip(8) --"
+echo "-- stage 2/19: dryrun_multichip(8) --"
 env JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 "
 
-echo "-- stage 3/18: bench smoke --"
+echo "-- stage 3/19: bench smoke --"
 # Reduced-size smoke of the bench entrypoint: section harness, JSON
 # emission and the aggregate hot path must run end-to-end on CPU.
 env JAX_PLATFORMS=cpu python - <<'EOF'
@@ -183,7 +185,7 @@ EOF
 # deliberate changes with scripts/perf_gate.py --update)
 env JAX_PLATFORMS=cpu python scripts/perf_gate.py
 
-echo "-- stage 4/18: chaos smoke --"
+echo "-- stage 4/19: chaos smoke --"
 # One injected RESOURCE_EXHAUSTED (rung 1: device-cache evict + retry)
 # and one injected transient UNAVAILABLE (backoff retry), then Q1 must
 # still hit golden parity with both recoveries visible in fault_summary.
@@ -237,7 +239,7 @@ print(json.dumps({"preflight_chaos_smoke": "ok",
                                            qe2.fault_summary.items()}}))
 EOF
 
-echo "-- stage 5/18: observability + analysis smoke --"
+echo "-- stage 5/19: observability + analysis smoke --"
 env JAX_PLATFORMS=cpu python - <<'EOF2'
 import json
 import os
@@ -330,10 +332,10 @@ EOF2
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_obs_dir)"
 
-echo "-- stage 6/18: source lint (scripts/lint.py --all) --"
+echo "-- stage 6/19: source lint (scripts/lint.py --all) --"
 env JAX_PLATFORMS=cpu python scripts/lint.py --all
 
-echo "-- stage 7/18: SQL service smoke --"
+echo "-- stage 7/19: SQL service smoke --"
 # Start the concurrent SQL service on an ephemeral port, POST TPC-H Q1
 # over HTTP, check golden parity of the JSON rows, scrape-parse
 # GET /metrics, then shut down cleanly.
@@ -407,7 +409,7 @@ print(json.dumps({"preflight_service_smoke": "ok",
                   "rows": int(resp["row_count"])}))
 EOF3
 
-echo "-- stage 8/18: join-kernel + ingest parity smoke --"
+echo "-- stage 8/19: join-kernel + ingest parity smoke --"
 # Q3+Q5 byte-identical across join.kernelMode hash/sort and
 # ingest.prefetch on/off; the hash path must actually have run (a
 # join_table_slots_* metric) so the parity check can't go vacuous.
@@ -465,7 +467,7 @@ print(json.dumps({"preflight_join_kernel_smoke": "ok",
                   "microbench": mb}))
 EOF4
 
-echo "-- stage 9/18: TPC-DS + join-reorder smoke --"
+echo "-- stage 9/19: TPC-DS + join-reorder smoke --"
 # SF0.01 datagen, q3 + q19 golden parity, and the cost-based join
 # reorder proven live: on/off byte-identical with q19's join order
 # demonstrably changed (decision log + differing physical plans).
@@ -509,7 +511,7 @@ print(json.dumps({"preflight_tpcds_smoke": "ok",
                   "reordered_queries": reordered}))
 EOF5
 
-echo "-- stage 10/18: elastic mesh smoke --"
+echo "-- stage 10/19: elastic mesh smoke --"
 # A host lost mid-stream (fatal at the 2nd mesh snapshot point) must
 # gang-restart the mesh — NOT degrade to single-device — resume from
 # the chunk-2 checkpoint with a bounded replay, and hit golden parity.
@@ -559,7 +561,7 @@ print(json.dumps({"preflight_elastic_smoke": "ok",
                   "fault_summary": dict(qe.fault_summary)}))
 EOF6
 
-echo "-- stage 11/18: streaming durability smoke --"
+echo "-- stage 11/19: streaming durability smoke --"
 # File source -> stateful query -> crash at the state-commit seam ->
 # query object discarded -> fresh query over the same checkpoint must
 # recover exactly-once (output byte-identical to an uninterrupted run)
@@ -652,7 +654,7 @@ EOF7
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_stream_dir)"
 
-echo "-- stage 12/18: concurrency smoke --"
+echo "-- stage 12/19: concurrency smoke --"
 # (a) the concurrency passes gate machine-readably at zero violations
 env JAX_PLATFORMS=cpu python - <<'EOF8'
 import json
@@ -735,7 +737,7 @@ print(json.dumps({"preflight_lockwatch_smoke": "ok",
                   "observed_edges": len(edges)}))
 EOF9
 
-echo "-- stage 13/18: compile-cache smoke --"
+echo "-- stage 13/19: compile-cache smoke --"
 # Cold Q1 in-process fills the persistent AOT compile cache; a FRESH
 # subprocess over the same dir must open warm (disk_hits >= 1, ZERO
 # disk misses = no backend recompiles of cached shapes) with
@@ -832,7 +834,7 @@ print(json.dumps({"preflight_compile_cache_smoke": "ok",
                   "corrupt_recovered": fixed["corrupt"]}))
 EOF11
 
-echo "-- stage 14/18: query-lifecycle cancellation smoke --"
+echo "-- stage 14/19: query-lifecycle cancellation smoke --"
 # Start a chunked Q3 via the service, DELETE it mid-stream, assert the
 # structured error + no thread leak + arbiter drained + an immediate
 # clean re-run at golden parity (the cancellation hard guarantee).
@@ -928,7 +930,7 @@ print(json.dumps({"preflight_cancellation_smoke": "ok",
                   "cancel_latency_s": round(latency_s, 3)}))
 EOF12
 
-echo "-- stage 15/18: python-UDF worker pool smoke --"
+echo "-- stage 15/19: python-UDF worker pool smoke --"
 # Worker-lane parity with in-process, an injected SIGKILL mid-batch
 # replaying exactly one batch, and the zero-leaked-children contract.
 env JAX_PLATFORMS=cpu python - <<'EOF13'
@@ -993,7 +995,7 @@ print(json.dumps({
     "workers_spawned": len(s._udf_pool.child_procs())}))
 EOF13
 
-echo "-- stage 16/18: unattended streaming smoke --"
+echo "-- stage 16/19: unattended streaming smoke --"
 # Socket producer under the supervised trigger loop: a mid-stream
 # connection kill must reconnect exactly once with zero loss, an
 # injected trigger_tick fatal must park the query in structured FAILED,
@@ -1103,7 +1105,7 @@ print(json.dumps({
     "groups": int(len(got))}))
 EOF14
 
-echo "-- stage 17/18: status store + flight recorder smoke --"
+echo "-- stage 17/19: status store + flight recorder smoke --"
 # Live /status must parse with latency percentiles after one query,
 # /status/timeseries must carry heartbeat-sampled series, and an
 # injected stage_run fatal must leave a flight-recorder bundle whose
@@ -1212,7 +1214,7 @@ print(json.dumps({"preflight_status_smoke": "ok",
                   "bundle": os.path.basename(b)}))
 EOF15
 
-echo "-- stage 18/18: plan-integrity smoke --"
+echo "-- stage 18/19: plan-integrity smoke --"
 # (a) 64-seed differential fuzz: optimizer-on vs -off (full validation)
 # plus one rule ablation per seed — byte parity, zero integrity
 # findings, stable stage keys (the RL100 rule-registry lint already
@@ -1260,5 +1262,161 @@ EOF16
 # the v7 rule_trace lines validate against the versioned schema
 env JAX_PLATFORMS=cpu python scripts/events_tool.py validate \
     "$(cat /tmp/_preflight_pi_dir)"
+
+echo "-- stage 19/19: serving-fleet smoke --"
+# Crash-only fleet loop end-to-end: 2 supervised worker subprocesses
+# behind the session-affinity router, Q1 golden parity through the
+# router AND direct at the owning worker (same bytes), kill -9 the
+# home worker mid-query (a slow-stage fault holds it on device) and
+# require the idempotent-read failover answer — 200 with
+# X-Fleet-Failover and parity, or the structured 503 WORKER_LOST —
+# then the fleet back at 2 ready with the respawned worker serving
+# Q1 from the SHARED persistent compile cache (disk hit, no
+# recompile), and a SIGTERM-path drain that exits clean with zero
+# orphaned worker processes. warmStart stays off here so the
+# respawn's cache heat is visible on the disk-hit counter (the
+# warm-start replay path is stage 13's surface).
+env JAX_PLATFORMS=cpu python - <<'EOF17'
+import json
+import os
+import signal
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pandas as pd
+
+from spark_tpu import Conf
+from spark_tpu.observability.metrics import parse_prometheus_text
+from spark_tpu.service.fleet import FleetSupervisor
+from spark_tpu.tpch import golden as G
+from spark_tpu.tpch import sql_queries as SQLQ
+from spark_tpu.tpch.datagen import write_parquet
+
+base = tempfile.mkdtemp(prefix="preflight_fleet_")
+path = base + "/sf"
+write_parquet(path, 0.001)
+os.makedirs(base + "/init")
+with open(base + "/init/preflight_fleet_init.py", "w") as f:
+    f.write("import spark_tpu.tpch.queries as Q\n"
+            f"PATH = {path!r}\n"
+            "def init(session):\n"
+            "    Q.register_tables(session, PATH)\n")
+os.environ["PYTHONPATH"] = base + "/init" + (
+    os.pathsep + os.environ["PYTHONPATH"]
+    if os.environ.get("PYTHONPATH") else "")
+
+conf = (Conf()
+        .set("spark_tpu.service.port", 0)
+        .set("spark_tpu.service.fleet.workers", 2)
+        .set("spark_tpu.service.fleet.healthIntervalMs", 100)
+        .set("spark_tpu.service.fleet.restartBackoffMs", 100)
+        .set("spark_tpu.service.fleet.init",
+             "preflight_fleet_init:init")
+        .set("spark_tpu.service.fleet.dir", base + "/fleet")
+        .set("spark_tpu.sql.warehouse.dir", base + "/wh")
+        .set("spark_tpu.sql.compileCache.enabled", True)
+        .set("spark_tpu.sql.compileCache.dir", base + "/cc")
+        .set("spark_tpu.sql.compileCache.warmStart", False))
+sup = FleetSupervisor(conf).start()
+assert sup.wait_ready(300), sup.fleet_health()
+
+
+def post(port, sql, session, extra=None, timeout=300):
+    body = {"sql": sql, "session": session}
+    body.update(extra or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/sql",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def check_parity(resp):
+    got = pd.DataFrame(resp["rows"], columns=resp["columns"])
+    want = G.GOLDEN["q1"](path)
+    G.compare(G.normalize_decimals(got)[list(want.columns)]
+              .reset_index(drop=True), want.reset_index(drop=True))
+
+
+worker_pids = sup.worker_pids()
+home = sup._route("pf")[0]
+home_snap = sup._workers[home].snapshot()
+
+# routed vs direct: same golden bytes through both doors
+st, hdrs, resp = post(sup.port, SQLQ.Q1, "pf")
+assert st == 200 and resp["status"] == "ok", resp
+assert int(hdrs["X-Fleet-Worker"]) == home, hdrs
+check_parity(resp)
+st, _, direct = post(home_snap["port"], SQLQ.Q1, "pf")
+assert st == 200, direct
+assert direct["rows"] == resp["rows"], "router vs direct divergence"
+
+# kill -9 the home worker mid-query: the sync read either fails over
+# (200 + X-Fleet-Failover + parity) or sheds the structured 503
+import threading
+out = []
+t = threading.Thread(target=lambda: out.append(post(
+    sup.port, SQLQ.Q1, "pf",
+    {"conf": {"spark_tpu.faults.inject": "stage_run:slow:1:2500"}})),
+    daemon=True)
+t.start()
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    listing = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{sup.port}/queries", timeout=30).read())
+    if any(q.get("status") == "running"
+           for q in listing.get("queries", [])):
+        break
+    time.sleep(0.05)
+os.kill(home_snap["pid"], signal.SIGKILL)
+t.join(300)
+st, hdrs, resp = out[0]
+if st == 200:
+    assert hdrs.get("X-Fleet-Failover") == "1", hdrs
+    check_parity(resp)
+    failover = "parity"
+else:
+    assert st == 503 and resp["error"] in (
+        "WORKER_LOST", "FLEET_UNAVAILABLE"), resp
+    failover = resp["error"]
+
+# crash-only recovery: back at 2 ready, and the RESPAWNED worker
+# serves Q1 hot from the shared persistent cache (disk hit)
+assert sup.wait_ready(300), sup.fleet_health()
+respawn = sup._workers[home].snapshot()
+assert respawn["generation"] >= 2, respawn
+st, _, resp = post(respawn["port"], SQLQ.Q1, "pf2")
+assert st == 200, resp
+check_parity(resp)
+prom = parse_prometheus_text(urllib.request.urlopen(
+    f"http://127.0.0.1:{respawn['port']}/metrics",
+    timeout=30).read().decode())
+assert prom.get("spark_tpu_compile_cache_disk_hits", 0) >= 1, \
+    "respawned worker recompiled instead of loading the shared cache"
+
+# SIGTERM-path drain: clean exit, zero orphans
+assert sup.shutdown(), "fleet drain was not clean"
+worker_pids += [respawn["pid"]]
+for pid in worker_pids:
+    for _ in range(200):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError(f"orphaned worker pid {pid}")
+print(json.dumps({"preflight_fleet_smoke": "ok",
+                  "failover": failover,
+                  "respawned_generation": respawn["generation"],
+                  "disk_hits": int(
+                      prom["spark_tpu_compile_cache_disk_hits"])}))
+EOF17
 
 echo "== preflight PASSED =="

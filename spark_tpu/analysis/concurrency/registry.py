@@ -88,10 +88,27 @@ _OBS = "spark_tpu/observability/"
 #: (the guarded-by pass fails both on an unregistered lock object and
 #: on a stale declaration). Ranks: see module docstring.
 LOCKS: Tuple[LockDecl, ...] = (
+    LockDecl("service.stop", _SVC + "server.py", "SqlService",
+             "_stop_lock", "lock", 8,
+             "serializes stop() (idempotent, signal-safe) and guards "
+             "the _stopped/_draining flags; ranked below everything "
+             "stop() tears down (it nests service.install inside)"),
     LockDecl("service.session", _SVC + "pool.py", "_Entry", "lock",
              "lock", 10,
              "per-session execution lease; held across the whole query "
              "(outermost — everything below may nest inside it)"),
+    LockDecl("service.fleet_inflight", _SVC + "fleet.py",
+             "FleetSupervisor", "_cv", "condition", 12,
+             "router in-flight proxied-request count + drain flag "
+             "(cv: drain waits here for in-flight to reach zero); "
+             "counter/flag ops only inside — proxy I/O, routing and "
+             "metrics run OUTSIDE it"),
+    LockDecl("service.fleet_worker", _SVC + "fleet.py", "_Worker",
+             "_lock", "lock", 13,
+             "per-worker lifecycle slice (state/port/proc/generation/"
+             "restart bookkeeping), the streaming _TriggerStatus "
+             "pattern: field ops only inside — spawn I/O, health "
+             "probes, bundle dumps and metrics all run OUTSIDE it"),
     LockDecl("service.pool", _SVC + "pool.py", "SessionPool", "_lock",
              "lock", 14, "session-pool entry map"),
     LockDecl("service.quota", _SVC + "admission.py", "SessionQuota",
@@ -257,6 +274,26 @@ GUARDED_BY: Tuple[GuardDecl, ...] = (
               "_async_lock"),
     GuardDecl(_SVC + "server.py", "SqlService", "_installed_arbiter",
               "_install_lock"),
+    GuardDecl(_SVC + "server.py", "SqlService", "_stopped",
+              "_stop_lock"),
+    GuardDecl(_SVC + "server.py", "SqlService", "_draining",
+              "_stop_lock"),
+    # fleet supervisor + per-worker slices
+    GuardDecl(_SVC + "fleet.py", "FleetSupervisor", "_inflight", "_cv"),
+    GuardDecl(_SVC + "fleet.py", "FleetSupervisor", "_draining", "_cv"),
+    GuardDecl(_SVC + "fleet.py", "FleetSupervisor", "_stopped", "_cv"),
+    GuardDecl(_SVC + "fleet.py", "FleetSupervisor", "_seq", "_cv"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "state", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "port", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "pid", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "proc", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "generation", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "policy", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "next_spawn_ts", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "spawn_deadline_ts",
+              "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "ping_failures", "_lock"),
+    GuardDecl(_SVC + "fleet.py", "_Worker", "crash_times", "_lock"),
     GuardDecl(_SVC + "query_history.py", "QueryHistoryStore",
               "_entries", "_lock"),
     # observability
@@ -382,6 +419,16 @@ WAIVERS: Tuple[Waiver, ...] = (
            "lifecycle attr written by the owning control thread in "
            "start()/stop(); the thread itself only fills the "
            "arbiter's waived stage_cache dict"),
+    Waiver(_SVC + "fleet.py", "FleetSupervisor", "_httpd",
+           "lifecycle attr written by the owning control thread in "
+           "start()/stop(), not on the request path (the "
+           "SqlService._httpd precedent)"),
+    Waiver(_SVC + "fleet.py", "FleetSupervisor", "_serve_thread",
+           "lifecycle attr written by the owning control thread in "
+           "start()/stop(), not on the request path"),
+    Waiver(_SVC + "fleet.py", "FleetSupervisor", "_health_thread",
+           "lifecycle attr written by the owning control thread in "
+           "start()/stop(), not on the request path"),
     Waiver(_OBS + "status_store.py", "StatusStore", "_thread",
            "lifecycle attr written by the owning control thread in "
            "start()/stop(), not on the request path (the "

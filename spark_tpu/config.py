@@ -264,6 +264,19 @@ EXEC_QUERY_DEADLINE_MS = register(
         "stageTimeoutMs TIMEOUT class). 0 disables.",
     validator=lambda v: v >= 0)
 
+EXEC_DISPATCH_POLL_MS = register(
+    "spark_tpu.execution.dispatchPollMs", 25,
+    doc="Cancellable host sync of a DISPATCHED stage: with a cancel "
+        "token installed, the post-dispatch stats pull polls the "
+        "output arrays' readiness instead of blocking in "
+        "jax.device_get — the tick ramps 1ms up to this cap, so a "
+        "cancel (DELETE /queries/<id>) or a blown queryDeadlineMs "
+        "lands within ~one capped tick while the device compute "
+        "proceeds in the background, and short stages pay ~1ms of "
+        "added sync latency. 0 restores the blocking sync "
+        "(cancellation then lands only when the stage completes).",
+    validator=lambda v: v >= 0)
+
 CHUNK_RETRY_ENABLED = register(
     "spark_tpu.execution.chunkRetry.enabled", True,
     doc="Chunk-granular retry inside the streaming drivers "
@@ -842,6 +855,103 @@ SERVICE_SESSION_HBM_SHARE = register(
         "and the rest of the pool stays available to other sessions. "
         "0 disables the share cap.",
     validator=lambda v: 0 <= v <= 1)
+
+SERVICE_ID_PREFIX = register(
+    "spark_tpu.service.idPrefix", "",
+    doc="Namespace prefix for service query ids (q-<prefix><seq>). "
+        "Empty for a standalone service; the fleet supervisor "
+        "(service/fleet.py) sets 'w<idx>g<gen>-' per worker so the "
+        "router can map an id back to the worker (and generation) "
+        "that owns its record.")
+
+FLEET_WORKERS = register(
+    "spark_tpu.service.fleet.workers", 2,
+    doc="Number of SqlService worker subprocesses the fleet "
+        "supervisor (service/fleet.py) runs. Each worker binds an "
+        "ephemeral port and shares the persistent compile-cache dir, "
+        "so a respawned worker opens hot.",
+    validator=lambda v: v >= 1)
+
+FLEET_RESTART_MAX_PER_WINDOW = register(
+    "spark_tpu.service.fleet.restartMaxPerWindow", 3,
+    doc="Flap breaker: a worker crashing this many times within "
+        "fleet.restartWindowMs is QUARANTINED — no further restarts, "
+        "its ring share re-homes to the surviving workers and excess "
+        "load sheds through their admission 429/503 bounds (graceful "
+        "degradation, never a hang).",
+    validator=lambda v: v >= 1)
+
+FLEET_RESTART_WINDOW_MS = register(
+    "spark_tpu.service.fleet.restartWindowMs", 60000,
+    doc="Flap-breaker crash-counting window (milliseconds) for "
+        "fleet.restartMaxPerWindow.",
+    validator=lambda v: v >= 1)
+
+FLEET_RESTART_BACKOFF_MS = register(
+    "spark_tpu.service.fleet.restartBackoffMs", 200,
+    doc="Base delay of the worker-restart exponential-backoff ladder "
+        "(the execution RetryPolicy reused supervisor-side): crash n "
+        "within a window waits ~backoff * 2^n (jittered) before the "
+        "respawn.",
+    validator=lambda v: v >= 0)
+
+FLEET_DRAIN_TIMEOUT_MS = register(
+    "spark_tpu.service.fleet.drainTimeoutMs", 10000,
+    doc="Bounded drain budget (milliseconds): on SIGTERM the "
+        "supervisor stops admitting (structured FLEET_DRAINING 503), "
+        "waits this long for in-flight proxied requests, SIGTERMs the "
+        "workers (each drains its own in-flight queries under the "
+        "same bound, on top of their queryDeadlineMs budgets), then "
+        "SIGKILLs stragglers and exits 0. Also the default budget of "
+        "SqlService.drain().",
+    validator=lambda v: v >= 0)
+
+FLEET_FAILOVER_READS = register(
+    "spark_tpu.service.fleet.failoverReads", True,
+    doc="Transparently retry an idempotent read query (SELECT / WITH "
+        "/ VALUES / EXPLAIN / SHOW / DESCRIBE) exactly once on the "
+        "re-homed worker when its worker dies mid-request — byte "
+        "parity is guaranteed by the deterministic engine + shared "
+        "compile cache. Off (and for every non-read), the client gets "
+        "a structured 503 WORKER_LOST instead.")
+
+FLEET_HEALTH_INTERVAL_MS = register(
+    "spark_tpu.service.fleet.healthIntervalMs", 250,
+    doc="Supervisor health-check cadence (milliseconds): each tick "
+        "polls worker liveness (subprocess exit + HTTP ping) and "
+        "readiness (GET /healthz/ready — warm-start replay done), "
+        "re-homes traffic off non-ready workers, and runs the "
+        "restart ladder for due respawns.",
+    validator=lambda v: v >= 10)
+
+FLEET_SPAWN_TIMEOUT_MS = register(
+    "spark_tpu.service.fleet.spawnTimeoutMs", 90000,
+    doc="Budget (milliseconds) for a spawned worker to hand its port "
+        "back and report ready; a worker exceeding it is killed and "
+        "counts as a crash in the flap-breaker window.",
+    validator=lambda v: v >= 1)
+
+FLEET_PROXY_TIMEOUT_MS = register(
+    "spark_tpu.service.fleet.proxyTimeoutMs", 600000,
+    doc="Socket timeout (milliseconds) on one proxied worker request; "
+        "queries bound their own wall-clock via queryDeadlineMs, so "
+        "this is the backstop against a wedged worker socket.",
+    validator=lambda v: v >= 1)
+
+FLEET_DIR = register(
+    "spark_tpu.service.fleet.dir", "",
+    doc="Directory for fleet runtime artifacts: worker-death "
+        "diagnostic bundles (MANIFEST.json + stderr tail + restart "
+        "history per bundle-worker<idx>-g<gen>-<reason>/). Empty uses "
+        "<tmpdir>/spark-tpu-fleet.")
+
+FLEET_INIT = register(
+    "spark_tpu.service.fleet.init", "",
+    doc="Worker session-init hook as an import spec "
+        "('module:function'); each worker resolves it and passes the "
+        "callable to SqlService(init_session=...) — table "
+        "registration must survive respawn, so it ships as a spec, "
+        "not a closure. Empty for no init hook.")
 
 SERVICE_QUERY_LOG_SIZE = register(
     "spark_tpu.service.queryLogSize", 512,

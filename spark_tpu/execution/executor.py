@@ -31,6 +31,43 @@ class _ReplanRequest(Exception):
     """Internal: restart execution after a strategy re-plan."""
 
 
+DISPATCH_POLL_KEY = "spark_tpu.execution.dispatchPollMs"
+
+
+def _sync_dispatched(outs, conf):
+    """Host-sync a dispatched stage's stats channel, cancellably.
+
+    `jax.device_get` blocks until the device computation completes, so
+    a cancel of a DISPATCHED stage used to land only when the stage
+    finished. With a cancel token installed and dispatchPollMs > 0,
+    poll the output arrays' readiness instead: each tick checks the
+    token, so a DELETE /queries/<id> or a blown queryDeadlineMs raises
+    the structured lifecycle error within ~one poll interval (the
+    device compute keeps running in the background — XLA offers no
+    kill — but the host thread, its leases and its session lease are
+    released promptly). Checks the token DIRECTLY rather than through
+    lifecycle.checkpoint: readiness polling is timing-dependent, and
+    routing it through the `cancel_point` chaos seam would make the
+    cancel matrix's nth-boundary targeting nondeterministic.
+
+    The tick ramps 1ms -> dispatchPollMs (doubling): short stages —
+    the overwhelmingly common case on a serving path — pay ~1ms of
+    added sync latency instead of a full poll interval, while the
+    cancel-latency bound for long stages stays ~dispatchPollMs."""
+    from . import lifecycle
+    tok = lifecycle.current_token()
+    poll_ms = float(conf.get(DISPATCH_POLL_KEY) or 0)
+    if tok is not None and poll_ms > 0:
+        leaves = [a for a in jax.tree_util.tree_leaves(outs)
+                  if hasattr(a, "is_ready")]
+        tick_s = min(0.001, poll_ms / 1e3)
+        while not all(a.is_ready() for a in leaves):
+            tok.check("dispatch_wait")
+            tok.wait(tick_s)
+            tick_s = min(tick_s * 2, poll_ms / 1e3)
+    return jax.device_get(outs)
+
+
 class QueryExecution:
     def __init__(self, session, logical: L.LogicalPlan):
         from ..observability import SpanRecorder
@@ -1581,8 +1618,12 @@ class QueryExecution:
                 # ONE batched host pull for the whole stats channel —
                 # per-scalar np.asarray costs an RPC round trip each on
                 # tunneled runtimes (it also syncs the attempt, making
-                # the wall-clock deadline check below honest)
-                flags, metrics = jax.device_get((flags, metrics))
+                # the wall-clock deadline check below honest). The pull
+                # is cancellable (dispatchPollMs readiness polling):
+                # a cancel/deadline lands within ~one tick instead of
+                # at stage completion
+                flags, metrics = _sync_dispatched((flags, metrics),
+                                                  self._conf)
                 # jit compiles lazily: the first dispatch after a stage
                 # -cache miss pays trace + XLA compile in-line, so flag
                 # it — trace readers must not read that as execution

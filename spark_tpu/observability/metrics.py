@@ -102,6 +102,15 @@ METRIC_PREFIXES = (
     # crash/timeout, and spawn+handshake wall-clock
     "udf_",            # udf_batches/udf_rows/udf_exec_ms/
                        # udf_worker_restarts/udf_worker_spawn_ms
+    # serving fleet (service/fleet.py): REGISTRY counters/gauges on
+    # the SUPERVISOR's registry, listed for namespace closure —
+    # worker spawns/restarts/losses, quarantines, proxied and shed
+    # requests, transparent read failovers, drains, death bundles
+    "fleet_",          # fleet_workers_ready/fleet_spawns/
+                       # fleet_restarts/fleet_worker_lost/
+                       # fleet_quarantined/fleet_requests_proxied/
+                       # fleet_requests_shed/fleet_failovers/
+                       # fleet_drains/fleet_bundles
     # engine status store (observability/status_store.py + the metrics
     # sink listener): REGISTRY histograms/counters/gauges, listed for
     # namespace closure — end-to-end and per-phase latency

@@ -65,6 +65,16 @@ class AdmissionTimeout(AdmissionError):
     http_status = 503
 
 
+class ServiceDraining(AdmissionError):
+    """The service is draining (SIGTERM / explicit drain()): new
+    submissions shed at the front door while in-flight queries finish
+    under the bounded drain budget. 503: the condition is transient —
+    a router retries elsewhere."""
+
+    code = "SERVICE_DRAINING"
+    http_status = 503
+
+
 class SessionQuotaExceeded(AdmissionError):
     """The session's per-session in-flight quota
     (spark_tpu.service.session.maxConcurrent) is full."""

@@ -34,7 +34,12 @@ Endpoints:
 - ``GET /metrics``: the shared metrics registry in Prometheus text
   exposition (queries, admission, arbiter, compile/result caches,
   latency histograms with native ``_bucket``/``_sum``/``_count``).
-- ``GET /healthz``: liveness + pool/admission/arbiter/quota stats.
+- ``GET /healthz``: combined health + pool/admission/arbiter/quota
+  stats (now with ``ready``/``draining``). ``GET /healthz/live`` and
+  ``GET /healthz/ready`` split liveness from readiness: a worker
+  replaying its warm-start manifest is live-but-not-ready (ready is
+  503 NOT_READY until the replay finishes), so a fleet router
+  withholds traffic instead of racing the replay.
 - ``GET /status``: the status store's live health snapshot — queries
   in flight and per-phase outcomes per session, admission queue
   depth, arbiter lease occupancy, cache hit rates, p50/p95/p99 query
@@ -78,7 +83,7 @@ from ..sql.lexer import ParseError
 from ..udf_worker import UdfError
 from .admission import (SESSION_MAX_CONCURRENT_KEY, AdmissionController,
                         AdmissionError, AdmissionRejected,
-                        AdmissionTimeout, SessionQuota)
+                        AdmissionTimeout, ServiceDraining, SessionQuota)
 from .arbiter import (DeviceResourceArbiter, get_arbiter, install_arbiter)
 from .pool import PoolExhausted, SessionPool
 from .query_history import (HISTORY_SIZE_KEY, QueryHistoryStore,
@@ -92,6 +97,8 @@ PORT_KEY = "spark_tpu.service.port"
 HBM_BUDGET_KEY = "spark_tpu.service.hbmBudget"
 RESULT_CACHE_KEY = "spark_tpu.service.resultCacheBytes"
 QUERY_LOG_KEY = "spark_tpu.service.queryLogSize"
+ID_PREFIX_KEY = "spark_tpu.service.idPrefix"
+DRAIN_TIMEOUT_KEY = "spark_tpu.service.fleet.drainTimeoutMs"
 
 
 class _StatusListener(QueryListener):
@@ -204,7 +211,24 @@ class SqlService:
         self._install_lock = threading.Lock()
         self._record_bound = int(self.conf.get(QUERY_LOG_KEY))
         self._seq = 0
+        self._id_prefix = str(self.conf.get(ID_PREFIX_KEY) or "")
         self._started_ts = time.time()
+        #: readiness gate behind GET /healthz/ready: set once the
+        #: warm-start manifest replay finished (immediately when warm
+        #: start is off) — a fleet router withholds traffic until then
+        self._ready = threading.Event()
+        #: serializes stop() (idempotent, signal-safe: a SIGTERM's
+        #: drain thread and an explicit stop() must not both tear the
+        #: httpd down) and guards the _stopped/_draining flags
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+        #: draining: new submissions shed with SERVICE_DRAINING (503)
+        #: while in-flight queries finish under the drain budget
+        self._draining = False
+        #: set by stop() AFTER teardown completes (never by the signal
+        #: handler directly): worker mains park on wait_for_shutdown()
+        #: and must not wake until the drain has run
+        self._shutdown_event = threading.Event()
         # lifecycle attrs (guarded-by waiver): written only by the
         # owning control thread in start()/stop(), not on the request
         # path
@@ -262,7 +286,7 @@ class SqlService:
         tok = lifecycle.CancelToken(deadline_ms=ms if ms > 0 else None)
         with self._records_lock:
             self._seq += 1
-            rid = f"q-{self._seq}"
+            rid = f"q-{self._id_prefix}{self._seq}"
             record = {"id": rid, "sql": sql[:500], "session": session,
                       "status": "submitted", "submitted_ts": time.time()}
             self._records[rid] = record
@@ -300,6 +324,16 @@ class SqlService:
         return snap
 
     # -- submission ---------------------------------------------------------
+
+    def _check_draining(self) -> None:
+        """Front-door shed while draining: a new submission gets a
+        structured SERVICE_DRAINING 503 before it creates a record or
+        touches a quota slot (a router retries on another worker).
+        GIL-atomic flag read; writes are serialized under _stop_lock."""
+        if self._draining:
+            self.metrics.counter("service_drain_rejected").inc()
+            raise ServiceDraining(
+                "service is draining; not admitting new queries")
 
     def _ensure_arbiter(self) -> None:
         """Install the shared arbiter (when service.hbmBudget > 0) on
@@ -375,6 +409,7 @@ class SqlService:
         PoolExhausted / the structured lifecycle errors, or whatever
         the engine raised; the record reflects the outcome either
         way."""
+        self._check_draining()
         record = self._new_record(sql, session, conf)
         rid = record["id"]
         self._ensure_arbiter()
@@ -491,6 +526,7 @@ class SqlService:
         spawns: a DELETE arriving while the request is still queued
         cancels it out of the admission queue without it ever
         executing."""
+        self._check_draining()
         record = self._new_record(sql, session, conf)
         try:
             self.session_quota.acquire(session)
@@ -760,8 +796,17 @@ class SqlService:
         from ..observability.metrics import prometheus_text
         return prometheus_text(self.metrics.snapshot())
 
+    @property
+    def ready(self) -> bool:
+        """Readiness: the warm-start manifest replay (when enabled)
+        has completed — live-but-not-ready during the replay, so a
+        fleet router withholds traffic instead of racing it."""
+        return self._ready.is_set()
+
     def health(self) -> Dict:
         return {"status": "ok",
+                "ready": self.ready,
+                "draining": self._draining,
                 "uptime_s": round(time.time() - self._started_ts, 1),
                 "sessions": len(self.pool),
                 "admission": self.admission.stats(),
@@ -809,14 +854,22 @@ class SqlService:
         if bool(self.conf.get(CC.WARM_START_KEY)) \
                 and CC.get_cache(self.conf) is not None:
             def warm():
-                n = CC.warm_start(self.arbiter.stage_cache, self.conf,
-                                  self.metrics)
-                if n:
-                    self.metrics.gauge("service_warm_stages").set(n)
+                # live-but-not-ready while the manifest replays:
+                # readiness flips in the finally so a replay failure
+                # degrades to cold compiles, never a stuck NOT_READY
+                try:
+                    n = CC.warm_start(self.arbiter.stage_cache,
+                                      self.conf, self.metrics)
+                    if n:
+                        self.metrics.gauge("service_warm_stages").set(n)
+                finally:
+                    self._ready.set()
 
             self._warm_thread = threading.Thread(
                 target=warm, daemon=True, name="sql-service-warmstart")
             self._warm_thread.start()
+        else:
+            self._ready.set()
         return self
 
     @property
@@ -824,25 +877,101 @@ class SqlService:
         return None if self._httpd is None \
             else self._httpd.server_address[1]
 
+    def drain(self, timeout_ms: Optional[float] = None) -> bool:
+        """Stop admitting (new submissions shed with a structured
+        SERVICE_DRAINING 503) and wait — bounded by `timeout_ms`,
+        default fleet.drainTimeoutMs — for in-flight work (running +
+        queued + async threads) to finish. In-flight queries keep
+        their own queryDeadlineMs budgets, so the wait is doubly
+        bounded. Returns True when the service drained dry within the
+        budget. Idempotent; safe before start()."""
+        with self._stop_lock:
+            self._draining = True
+        if timeout_ms is None:
+            timeout_ms = float(self.conf.get(DRAIN_TIMEOUT_KEY))
+        deadline = time.monotonic() + float(timeout_ms) / 1e3
+        while True:
+            stats = self.admission.stats()
+            with self._async_lock:
+                n_async = self._async_inflight
+            if (not stats.get("running") and not stats.get("queued")
+                    and n_async == 0):
+                self.metrics.counter("service_drains").inc()
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
     def stop(self) -> None:
         """Clean shutdown: stop accepting, close the socket, join the
         status-store heartbeat, uninstall the arbiter if this service
-        installed it."""
-        self.status_store.stop()
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._serve_thread is not None:
-            self._serve_thread.join(timeout=10)
-            self._serve_thread = None
-        if self._warm_thread is not None:
-            self._warm_thread.join(timeout=30)
-            self._warm_thread = None
-        with self._install_lock:
-            if self._installed_arbiter:
-                install_arbiter(None)
-                self._installed_arbiter = False
+        installed it. Idempotent and signal-safe: _stop_lock
+        serializes concurrent stops (a SIGTERM shutdown thread racing
+        an explicit stop(), or a double-stop) — the second caller
+        blocks on the bounded joins, then returns having torn nothing
+        down twice. Safe during warm start: the replay thread is
+        joined bounded (it only fills the waived stage_cache dict and
+        never takes _stop_lock, so no deadlock)."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._draining = True
+            self.status_store.stop()
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+                self._httpd = None
+            if self._serve_thread is not None:
+                self._serve_thread.join(timeout=10)
+                self._serve_thread = None
+            if self._warm_thread is not None:
+                self._warm_thread.join(timeout=30)
+                self._warm_thread = None
+            with self._install_lock:
+                if self._installed_arbiter:
+                    install_arbiter(None)
+                    self._installed_arbiter = False
+        self._shutdown_event.set()
+
+    def shutdown(self) -> None:
+        """The drain path: shed new work, bounded-wait in-flight, then
+        stop. What the SIGTERM/SIGINT handlers run (on a normal
+        thread) and what a fleet worker does when its supervisor
+        terminates it."""
+        self.drain()
+        self.stop()
+
+    def install_signal_handlers(self) -> None:
+        """Wire SIGTERM/SIGINT to the drain path. Handler-safe by
+        construction: the handler only spawns a normal thread for
+        shutdown() — stop() joins threads and takes locks, neither
+        legal inside a signal frame. The handler deliberately does NOT
+        set _shutdown_event: stop() sets it after teardown, so a
+        worker main parked on wait_for_shutdown() stays parked until
+        the drain has actually run (waking it early let the worker
+        exit with in-flight queries — async ones especially — still
+        running, silently skipping the bounded-drain guarantee).
+        Double delivery (or a signal racing an explicit stop())
+        serializes on _stop_lock and is a no-op the second time. Call
+        from the main thread (CPython restricts signal.signal to
+        it)."""
+        import signal
+
+        def _handler(signum, frame):
+            threading.Thread(target=self.shutdown, daemon=True,
+                             name="sql-service-shutdown").start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _handler)
+
+    def wait_for_shutdown(self,
+                          timeout: Optional[float] = None) -> bool:
+        """Park until stop() has completed — including the
+        signal-driven drain path, which only sets the event once the
+        drain ran and the service tore down (worker mains block here).
+        Returns whether the event fired."""
+        return self._shutdown_event.wait(timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -895,6 +1024,20 @@ def _make_handler(service: SqlService):
             path, _, query = self.path.partition("?")
             if path == "/healthz":
                 self._send_json(200, service.health())
+            elif path == "/healthz/live":
+                # liveness: the socket answers — distinct from ready
+                # (a worker replaying its warm-start manifest is live
+                # but must not take routed traffic yet)
+                self._send_json(200, {"live": True,
+                                      "ready": service.ready})
+            elif path == "/healthz/ready":
+                if service.ready:
+                    self._send_json(200, {"ready": True})
+                else:
+                    self._send_json(503, {
+                        "error": "NOT_READY",
+                        "message": "warm-start replay in progress",
+                        "ready": False})
             elif path == "/status":
                 self._send_json(200, service.status_store.snapshot())
             elif path == "/status/timeseries":

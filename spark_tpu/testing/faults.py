@@ -21,6 +21,7 @@ Grammar (comma-separated rules):
              | stream_sink_emit | compile_cache_load | cancel_point
              | udf_batch | udf_worker_spawn | stream_net_connect
              | stream_net_recv | trigger_tick | state_spill
+             | fleet_worker
              (KNOWN_SITES: the wired seams)
     fault := resource_exhausted | unavailable | deadline | fatal | slow
              | cancel
@@ -119,6 +120,14 @@ one batch replays on a fresh worker. `udf_worker_spawn` fires before
 each worker subprocess exec (udf_worker/pool.py), so spawn failures
 ride the same batch-replay path.
 
+`fleet_worker` fires before each worker-subprocess spawn attempt in
+the fleet supervisor (service/fleet.py — the `udf_worker_spawn`
+pattern one tier up): a raising rule models a worker that dies at
+boot, which rides the supervisor's RetryPolicy restart ladder and, at
+`restartMaxPerWindow` crashes within the window, trips the flap
+breaker into quarantine — the chaos vehicle for the fleet's
+graceful-degradation tests (tests/test_fleet.py).
+
 The `slow` fault sleeps on the INTERRUPTIBLE lifecycle wait, not a
 bare time.sleep: a cancel/deadline delivered mid-sleep wakes it
 immediately (raising the structured lifecycle error), so cancel-matrix
@@ -147,7 +156,7 @@ KNOWN_SITES = ("scan_load", "stage_compile", "stage_run", "shuffle",
                "stream_sink_emit", "compile_cache_load",
                "cancel_point", "udf_batch", "udf_worker_spawn",
                "stream_net_connect", "stream_net_recv",
-               "trigger_tick", "state_spill")
+               "trigger_tick", "state_spill", "fleet_worker")
 
 #: sites that fire INSIDE a stage trace (once per (re)compile of the
 #: enclosing stage). The persistent compile cache consults this: a

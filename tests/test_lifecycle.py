@@ -646,3 +646,121 @@ def test_cancel_matrix_service_async(service):
             f"http://127.0.0.1:{port}/metrics") as r:
         metrics = parse_prometheus_text(r.read().decode())
     assert metrics.get("spark_tpu_query_cancelled", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Dispatched-stage sync (executor._sync_dispatched): the dispatch gap
+# ---------------------------------------------------------------------------
+
+
+class _FakeDeviceArray:
+    """Stand-in for a dispatched jax.Array: is_ready() flips when the
+    'device' finishes; __array__ lets jax.device_get materialize it."""
+
+    def __init__(self, ready_after_s=0.0):
+        import numpy as np
+        self._value = np.zeros(2, dtype=np.int64)
+        self._ready_ts = time.monotonic() + ready_after_s
+
+    def is_ready(self):
+        return time.monotonic() >= self._ready_ts
+
+    def __array__(self, dtype=None):
+        return self._value
+
+
+def test_dispatch_poll_cancel_lands_mid_stage():
+    """Regression for the dispatch gap: with a never-ready output, a
+    cancel must land within ~one poll tick instead of blocking in
+    jax.device_get until the device finishes the stage."""
+    from spark_tpu.execution.executor import (DISPATCH_POLL_KEY,
+                                              _sync_dispatched)
+    conf = Conf().set(DISPATCH_POLL_KEY, 20)
+    tok = lifecycle.CancelToken()
+    ctx = lifecycle.install(tok)
+    try:
+        timer = threading.Timer(0.15, tok.cancel)
+        timer.start()
+        t0 = time.monotonic()
+        with pytest.raises(lifecycle.QueryCancelledError):
+            _sync_dispatched(
+                {"flags": _FakeDeviceArray(ready_after_s=3600)}, conf)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, f"cancel took {elapsed:.2f}s (gap back?)"
+        timer.cancel()
+    finally:
+        lifecycle.uninstall(ctx)
+
+
+def test_dispatch_poll_deadline_lands_mid_stage():
+    from spark_tpu.execution.executor import (DISPATCH_POLL_KEY,
+                                              _sync_dispatched)
+    conf = Conf().set(DISPATCH_POLL_KEY, 20)
+    tok = lifecycle.CancelToken(deadline_ms=150)
+    ctx = lifecycle.install(tok)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(lifecycle.QueryDeadlineError):
+            _sync_dispatched(
+                [_FakeDeviceArray(ready_after_s=3600)], conf)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        lifecycle.uninstall(ctx)
+
+
+def test_dispatch_poll_returns_when_ready():
+    """The poll loop exits on readiness and returns device_get's
+    result; arrays without is_ready (host values) never stall it."""
+    from spark_tpu.execution.executor import (DISPATCH_POLL_KEY,
+                                              _sync_dispatched)
+    import numpy as np
+    conf = Conf().set(DISPATCH_POLL_KEY, 20)
+    tok = lifecycle.CancelToken()
+    ctx = lifecycle.install(tok)
+    try:
+        out = _sync_dispatched(
+            {"a": _FakeDeviceArray(ready_after_s=0.1), "b": 7}, conf)
+        assert np.array_equal(out["a"], np.zeros(2, dtype=np.int64))
+        assert out["b"] == 7
+    finally:
+        lifecycle.uninstall(ctx)
+
+
+def test_dispatch_poll_disabled_blocks_straight_through():
+    """dispatchPollMs=0 (and no token) short-circuits to the plain
+    blocking device_get — the pre-existing fast path."""
+    from spark_tpu.execution.executor import (DISPATCH_POLL_KEY,
+                                              _sync_dispatched)
+    import numpy as np
+    conf = Conf().set(DISPATCH_POLL_KEY, 0)
+    out = _sync_dispatched([_FakeDeviceArray()], conf)
+    assert np.array_equal(out[0], np.zeros(2, dtype=np.int64))
+
+
+def test_dispatch_gap_regression_slow_stage_cancel(service):
+    """End-to-end: a slow-stage fault holds the dispatched stage on
+    device; DELETE /queries/<id> during the stall must cancel the
+    query promptly (structured QUERY_CANCELLED) instead of waiting
+    out the stage."""
+    svc = service().start()
+    rec = svc.submit_async(
+        "SELECT l_orderkey FROM lineitem LIMIT 4",
+        conf={INJECT_KEY: "stage_run:slow:1:5000"})
+    qid = rec["id"]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        r = svc.get_query(qid)
+        if r and r["status"] == "running":
+            break
+        time.sleep(0.01)
+    assert svc.cancel_query(qid), "cancel not delivered"
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        r = svc.get_query(qid)
+        if r["status"] not in ("submitted", "running"):
+            break
+        time.sleep(0.02)
+    assert r["status"] in ("cancelled", "ok"), r
+    if r["status"] == "cancelled":
+        assert r["error"]["error"] == "QUERY_CANCELLED"
+    _assert_no_prefetch_leak()
